@@ -1,0 +1,83 @@
+"""Benchmark harness: AlexNet ILSVRC12-shaped training throughput on TPU.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Baseline anchor (BASELINE.md): PMLS-Caffe trained AlexNet/ILSVRC12 to 56.5%
+top-1 in ~1 day on 8x K20. K20-era Caffe ran AlexNet at ~200 images/s/GPU
+forward+backward (batch 256); the 8-node PMLS cluster therefore sustained
+O(1.6k) images/s aggregate. vs_baseline is measured images/s/chip divided by
+200 (per-device parity with one K20 worker of the reference cluster).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+BASELINE_IMAGES_PER_SEC_PER_DEVICE = 200.0  # PMLS-Caffe AlexNet on one K20
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from poseidon_tpu import config
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import CommConfig, build_train_step, make_mesh
+    from poseidon_tpu.parallel.strategies import SFB
+    from poseidon_tpu.proto.messages import SolverParameter
+    from poseidon_tpu.parallel import init_train_state
+
+    # MXU-native numerics for the perf path.
+    config.set_policy(compute_dtype=jnp.bfloat16)
+
+    n_dev = jax.device_count()
+    per_dev_batch = 256
+    batch = per_dev_batch * n_dev
+    mesh = make_mesh()
+
+    shapes = {"data": (per_dev_batch, 3, 227, 227), "label": (per_dev_batch,)}
+    net = Net(zoo.alexnet(with_accuracy=False), phase="TRAIN",
+              source_shapes=shapes)
+    sp = SolverParameter(base_lr=0.01, lr_policy="step", gamma=0.1,
+                         stepsize=100000, momentum=0.9, weight_decay=5e-4)
+    comm = CommConfig(layer_strategies={"fc6": SFB, "fc7": SFB})
+    ts = build_train_step(net, sp, mesh, comm, donate=True)
+
+    params = net.init(jax.random.PRNGKey(0))
+    state = init_train_state(params, comm, n_dev)
+    rs = np.random.RandomState(0)
+    data = jnp.asarray(rs.rand(batch, 3, 227, 227).astype(np.float32),
+                       device=ts.batch_sharding)
+    label = jnp.asarray(rs.randint(0, 1000, size=(batch,)),
+                        device=ts.batch_sharding)
+    batch_dict = {"data": data, "label": label}
+    rng = jax.random.PRNGKey(1)
+
+    # Warmup / compile.
+    params, state, m = ts.step(params, state, batch_dict, rng)
+    jax.block_until_ready(m["loss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, state, m = ts.step(params, state, batch_dict, rng)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / dt
+    per_device = images_per_sec / n_dev
+    print(json.dumps({
+        "metric": "alexnet_ilsvrc12_train_images_per_sec_per_chip",
+        "value": round(per_device, 2),
+        "unit": "images/s/chip",
+        "vs_baseline": round(per_device / BASELINE_IMAGES_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

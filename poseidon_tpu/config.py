@@ -1,0 +1,54 @@
+"""Global numeric policy (the TPU analog of Caffe's Dtype template parameter).
+
+Parameters and accumulations stay float32; matmul/conv inputs are cast to
+``compute_dtype`` (bfloat16 by default on TPU — the MXU's native format) with
+float32 accumulation via ``preferred_element_type``. Set compute dtype to
+float32 for golden-value numerics tests against Caffe semantics.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+@dataclass
+class Policy:
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.float32  # flipped to bfloat16 by perf configs
+    accum_dtype: object = jnp.float32
+
+
+_policy = Policy()
+
+
+def policy() -> Policy:
+    return _policy
+
+
+def matmul_precision():
+    """float32 compute means Caffe-parity numerics: force exact f32 passes.
+    bfloat16 compute means MXU-native: let XLA use its fast default."""
+    import jax.lax
+    if _policy.compute_dtype == jnp.float32:
+        return jax.lax.Precision.HIGHEST
+    return jax.lax.Precision.DEFAULT
+
+
+def set_policy(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_policy, k):
+            raise AttributeError(k)
+        setattr(_policy, k, v)
+
+
+@contextmanager
+def policy_scope(**kwargs):
+    saved = {k: getattr(_policy, k) for k in kwargs}
+    set_policy(**kwargs)
+    try:
+        yield
+    finally:
+        set_policy(**saved)

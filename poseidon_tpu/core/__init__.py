@@ -1,0 +1,3 @@
+from .net import Net, NetOutputs, filter_net  # noqa: F401
+from .layers import ApplyCtx, REGISTRY, create_layer  # noqa: F401
+from .blob import ParamDef, nchw  # noqa: F401

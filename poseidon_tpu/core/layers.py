@@ -1,0 +1,650 @@
+"""The layer catalog: all reference layer types as shape-inferring, pure ops.
+
+Mirrors the capability of the 40-type catalog in
+``/root/reference/src/caffe/layers/`` + ``src/caffe/layer_factory.cpp`` while
+being functional: a layer is (setup: bottom shapes -> top shapes + ParamDefs,
+apply: params x bottoms -> tops). Backward never appears — it is derived by
+``jax.grad`` over the whole net — so the per-layer ``Backward_{cpu,gpu}``
+kernels of the reference have no analog here by design.
+
+Data-producing layers (DATA, IMAGE_DATA, HDF5_DATA, WINDOW_DATA, MEMORY_DATA)
+are *sources*: inside the traced graph their tops are external inputs; the
+actual IO lives in ``poseidon_tpu.data`` (host side, prefetched). DUMMY_DATA is
+generated in-graph from its fillers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import elementwise as E
+from ..ops import losses as L
+from ..ops import nn as NN
+from ..proto.messages import FillerParameter, LayerParameter
+from .blob import ParamDef
+from .fillers import fill
+
+Shape = Tuple[int, ...]
+
+LOSS_TYPES = {
+    "SOFTMAX_LOSS", "EUCLIDEAN_LOSS", "HINGE_LOSS", "INFOGAIN_LOSS",
+    "MULTINOMIAL_LOGISTIC_LOSS", "SIGMOID_CROSS_ENTROPY_LOSS",
+    "CONTRASTIVE_LOSS",
+}
+DATA_SOURCE_TYPES = {"DATA", "IMAGE_DATA", "HDF5_DATA", "WINDOW_DATA", "MEMORY_DATA"}
+
+
+class ApplyCtx:
+    """Per-call context threaded through Layer.apply."""
+
+    def __init__(self, train: bool, rng: Optional[jax.Array] = None, comm=None):
+        self.train = train
+        self.rng = rng
+        self.comm = comm  # parallel.strategies.CommContext or None
+
+    def layer_rng(self, index: int) -> Optional[jax.Array]:
+        if self.rng is None:
+            return None
+        return jax.random.fold_in(self.rng, index)
+
+
+class Layer:
+    TYPE = "NONE"
+    N_PARAMS = 0  # informational; actual defs built in setup
+
+    def __init__(self, lp: LayerParameter, phase: str, index: int = 0):
+        self.lp = lp
+        self.phase = phase
+        self.index = index
+        self.params: List[ParamDef] = []
+
+    @property
+    def name(self) -> str:
+        return self.lp.name
+
+    def default_loss_weight(self) -> float:
+        return 1.0 if self.TYPE in LOSS_TYPES else 0.0
+
+    def loss_weights(self, n_tops: int) -> List[float]:
+        lw = list(self.lp.loss_weight)
+        if not lw:
+            # Only top[0] of a loss layer carries loss by default (e.g.
+            # SOFTMAX_LOSS's optional second top is the prob blob).
+            return [self.default_loss_weight() if i == 0 else 0.0
+                    for i in range(n_tops)]
+        if len(lw) != n_tops:
+            raise ValueError(f"{self.name}: loss_weight arity mismatch")
+        return lw
+
+    def _param(self, name: str, shape: Shape, filler: FillerParameter,
+               blob_index: int) -> ParamDef:
+        spec = self.lp.param_spec(blob_index)
+        return ParamDef(name=name, shape=shape, filler=filler,
+                        lr_mult=spec.lr_mult, decay_mult=spec.decay_mult)
+
+    # -- protocol ---------------------------------------------------------- #
+    def setup(self, bottom_shapes: List[Shape]) -> List[Shape]:
+        raise NotImplementedError
+
+    def apply(self, params: Dict[str, jax.Array], bottoms: List[jax.Array],
+              ctx: ApplyCtx) -> List[jax.Array]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------- #
+# Parametric layers
+# --------------------------------------------------------------------------- #
+
+def _resolve_hw(single, h, w, default=None, *, what="", layer=""):
+    """Caffe's size-resolution rule with its CHECKs (conv/pooling LayerSetUp):
+    either the square `single` value or BOTH h and w; required unless a
+    default exists."""
+    if h or w:
+        if single:
+            raise ValueError(
+                f"layer {layer!r}: specify {what} as one size OR "
+                f"{what}_h/{what}_w, not both")
+        if not (h and w):
+            raise ValueError(
+                f"layer {layer!r}: both {what}_h and {what}_w are required "
+                f"for non-square {what}")
+        return int(h), int(w)
+    if single:
+        return int(single), int(single)
+    if default is None:
+        raise ValueError(f"layer {layer!r}: {what} must be specified")
+    return default, default
+
+
+class ConvolutionLayer(Layer):
+    TYPE = "CONVOLUTION"
+
+    def setup(self, bottom_shapes):
+        cp = self.lp.convolution_param
+        n, c, h, w = bottom_shapes[0]
+        self.kernel = _resolve_hw(cp.kernel_size, cp.kernel_h, cp.kernel_w,
+                                  what="kernel", layer=self.name)
+        self.stride = _resolve_hw(cp.stride, cp.stride_h, cp.stride_w, 1,
+                                  what="stride", layer=self.name)
+        self.pad = _resolve_hw(cp.pad, cp.pad_h, cp.pad_w, 0,
+                               what="pad", layer=self.name)
+        self.group = cp.group
+        self.bias_term = cp.bias_term
+        if c % self.group or cp.num_output % self.group:
+            raise ValueError(f"{self.name}: channels not divisible by group")
+        wshape = (cp.num_output, c // self.group, *self.kernel)
+        self.params = [self._param("w", wshape, cp.weight_filler, 0)]
+        if self.bias_term:
+            self.params.append(
+                self._param("b", (cp.num_output,), cp.bias_filler, 1))
+        oh = NN.conv_out_size(h, self.kernel[0], self.stride[0], self.pad[0])
+        ow = NN.conv_out_size(w, self.kernel[1], self.stride[1], self.pad[1])
+        return [(n, cp.num_output, oh, ow)] * len(self.lp.top)
+
+    def apply(self, params, bottoms, ctx):
+        w = params["w"]
+        b = params.get("b") if self.bias_term else None
+        if ctx.comm is not None:
+            w = ctx.comm.tap_param(self.name, "w", w)
+            if b is not None:
+                b = ctx.comm.tap_param(self.name, "b", b)
+        return [NN.conv2d(x, w, b, self.stride, self.pad, self.group)
+                for x in bottoms]
+
+
+class InnerProductLayer(Layer):
+    TYPE = "INNER_PRODUCT"
+
+    def setup(self, bottom_shapes):
+        ip = self.lp.inner_product_param
+        n = bottom_shapes[0][0]
+        k = int(np.prod(bottom_shapes[0][1:]))
+        self.bias_term = ip.bias_term
+        self.params = [self._param("w", (ip.num_output, k), ip.weight_filler, 0)]
+        if self.bias_term:
+            self.params.append(self._param("b", (ip.num_output,), ip.bias_filler, 1))
+        return [(n, ip.num_output)]
+
+    def apply(self, params, bottoms, ctx):
+        w = params["w"]
+        b = params.get("b") if self.bias_term else None
+        x = bottoms[0]
+        if ctx.comm is not None:
+            # SFB hook: the comm context may supply a sufficient-factor
+            # custom-vjp matmul for this layer (SURVEY §2.3; the reference's
+            # ComputeGradientFromSV path, inner_product_layer.cpp:126).
+            y = ctx.comm.inner_product(self.name, x, w, b)
+            if y is not None:
+                return [y]
+            w = ctx.comm.tap_param(self.name, "w", w)
+            if b is not None:
+                b = ctx.comm.tap_param(self.name, "b", b)
+        return [NN.inner_product(x, w, b)]
+
+
+# --------------------------------------------------------------------------- #
+# Vision layers
+# --------------------------------------------------------------------------- #
+
+class PoolingLayer(Layer):
+    TYPE = "POOLING"
+
+    def setup(self, bottom_shapes):
+        pp = self.lp.pooling_param
+        n, c, h, w = bottom_shapes[0]
+        if pp.global_pooling:
+            self.kernel = (h, w)
+            self.stride = (1, 1)
+            self.pad = (0, 0)
+        else:
+            self.kernel = _resolve_hw(pp.kernel_size, pp.kernel_h,
+                                      pp.kernel_w, what="kernel",
+                                      layer=self.name)
+            self.stride = _resolve_hw(pp.stride, pp.stride_h, pp.stride_w, 1,
+                                      what="stride", layer=self.name)
+            self.pad = _resolve_hw(pp.pad, pp.pad_h, pp.pad_w, 0,
+                                   what="pad", layer=self.name)
+        self.method = pp.pool
+        oh = NN.pool_out_size(h, self.kernel[0], self.stride[0], self.pad[0])
+        ow = NN.pool_out_size(w, self.kernel[1], self.stride[1], self.pad[1])
+        return [(n, c, oh, ow)]
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        if self.method == "MAX":
+            return [NN.max_pool(x, self.kernel, self.stride, self.pad)]
+        if self.method == "AVE":
+            return [NN.ave_pool(x, self.kernel, self.stride, self.pad)]
+        if self.method == "STOCHASTIC":
+            return [NN.stochastic_pool(x, self.kernel, self.stride, self.pad,
+                                       ctx.layer_rng(self.index), ctx.train)]
+        raise ValueError(f"unknown pool method {self.method}")
+
+
+class LRNLayer(Layer):
+    TYPE = "LRN"
+
+    def setup(self, bottom_shapes):
+        lp = self.lp.lrn_param
+        self.local_size = lp.local_size
+        self.alpha = lp.alpha
+        self.beta = lp.beta
+        self.region = lp.norm_region
+        self.k = lp.k
+        return [bottom_shapes[0]]
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        if self.region == "ACROSS_CHANNELS":
+            return [NN.lrn_across_channels(x, self.local_size, self.alpha,
+                                           self.beta, self.k)]
+        return [NN.lrn_within_channel(x, self.local_size, self.alpha, self.beta)]
+
+
+class Im2colLayer(Layer):
+    TYPE = "IM2COL"
+
+    def setup(self, bottom_shapes):
+        cp = self.lp.convolution_param
+        n, c, h, w = bottom_shapes[0]
+        self.kernel = _resolve_hw(cp.kernel_size, cp.kernel_h, cp.kernel_w,
+                                  what="kernel", layer=self.name)
+        self.stride = _resolve_hw(cp.stride, cp.stride_h, cp.stride_w, 1,
+                                  what="stride", layer=self.name)
+        self.pad = _resolve_hw(cp.pad, cp.pad_h, cp.pad_w, 0,
+                               what="pad", layer=self.name)
+        oh = NN.conv_out_size(h, self.kernel[0], self.stride[0], self.pad[0])
+        ow = NN.conv_out_size(w, self.kernel[1], self.stride[1], self.pad[1])
+        return [(n, c * self.kernel[0] * self.kernel[1], oh, ow)]
+
+    def apply(self, params, bottoms, ctx):
+        return [NN.im2col(bottoms[0], self.kernel, self.stride, self.pad)]
+
+
+# --------------------------------------------------------------------------- #
+# Neuron layers (shape-preserving elementwise)
+# --------------------------------------------------------------------------- #
+
+class _NeuronLayer(Layer):
+    def setup(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+
+class ReLULayer(_NeuronLayer):
+    TYPE = "RELU"
+
+    def apply(self, params, bottoms, ctx):
+        return [E.relu(bottoms[0], self.lp.relu_param.negative_slope)]
+
+
+class SigmoidLayer(_NeuronLayer):
+    TYPE = "SIGMOID"
+
+    def apply(self, params, bottoms, ctx):
+        return [E.sigmoid(bottoms[0])]
+
+
+class TanHLayer(_NeuronLayer):
+    TYPE = "TANH"
+
+    def apply(self, params, bottoms, ctx):
+        return [E.tanh(bottoms[0])]
+
+
+class BNLLLayer(_NeuronLayer):
+    TYPE = "BNLL"
+
+    def apply(self, params, bottoms, ctx):
+        return [E.bnll(bottoms[0])]
+
+
+class AbsValLayer(_NeuronLayer):
+    TYPE = "ABSVAL"
+
+    def apply(self, params, bottoms, ctx):
+        return [E.absval(bottoms[0])]
+
+
+class PowerLayer(_NeuronLayer):
+    TYPE = "POWER"
+
+    def apply(self, params, bottoms, ctx):
+        pp = self.lp.power_param
+        return [E.power(bottoms[0], pp.power, pp.scale, pp.shift)]
+
+
+class ThresholdLayer(_NeuronLayer):
+    TYPE = "THRESHOLD"
+
+    def apply(self, params, bottoms, ctx):
+        return [E.threshold(bottoms[0], self.lp.threshold_param.threshold)]
+
+
+class DropoutLayer(_NeuronLayer):
+    TYPE = "DROPOUT"
+
+    def apply(self, params, bottoms, ctx):
+        return [E.dropout(bottoms[0], self.lp.dropout_param.dropout_ratio,
+                          ctx.layer_rng(self.index), ctx.train)]
+
+
+# --------------------------------------------------------------------------- #
+# Structural layers
+# --------------------------------------------------------------------------- #
+
+class FlattenLayer(Layer):
+    TYPE = "FLATTEN"
+
+    def setup(self, bottom_shapes):
+        n = bottom_shapes[0][0]
+        return [(n, int(np.prod(bottom_shapes[0][1:])))]
+
+    def apply(self, params, bottoms, ctx):
+        return [E.flatten(bottoms[0])]
+
+
+class ConcatLayer(Layer):
+    TYPE = "CONCAT"
+
+    def setup(self, bottom_shapes):
+        self.axis = self.lp.concat_param.concat_dim
+        out = list(bottom_shapes[0])
+        out[self.axis] = sum(s[self.axis] for s in bottom_shapes)
+        return [tuple(out)]
+
+    def apply(self, params, bottoms, ctx):
+        return [E.concat(bottoms, self.axis)]
+
+
+class SliceLayer(Layer):
+    TYPE = "SLICE"
+
+    def setup(self, bottom_shapes):
+        sp = self.lp.slice_param
+        self.axis = sp.slice_dim
+        self.points = list(sp.slice_point)
+        n_top = len(self.lp.top)
+        size = bottom_shapes[0][self.axis]
+        if self.points:
+            bounds = [0] + self.points + [size]
+        else:
+            if size % n_top != 0:
+                raise ValueError(
+                    f"layer {self.lp.name!r}: cannot slice axis of size "
+                    f"{size} into {n_top} equal tops")
+            bounds = [i * (size // n_top) for i in range(n_top + 1)]
+        shapes = []
+        for i in range(n_top):
+            s = list(bottom_shapes[0])
+            s[self.axis] = bounds[i + 1] - bounds[i]
+            shapes.append(tuple(s))
+        return shapes
+
+    def apply(self, params, bottoms, ctx):
+        return E.slice_blob(bottoms[0], self.axis, self.points, len(self.lp.top))
+
+
+class SplitLayer(Layer):
+    TYPE = "SPLIT"
+
+    def setup(self, bottom_shapes):
+        return [bottom_shapes[0]] * len(self.lp.top)
+
+    def apply(self, params, bottoms, ctx):
+        return [bottoms[0]] * len(self.lp.top)
+
+
+class EltwiseLayer(Layer):
+    TYPE = "ELTWISE"
+
+    def setup(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, params, bottoms, ctx):
+        ep = self.lp.eltwise_param
+        return [E.eltwise(bottoms, ep.operation, ep.coeff)]
+
+
+class MVNLayer(_NeuronLayer):
+    TYPE = "MVN"
+
+    def apply(self, params, bottoms, ctx):
+        mp = self.lp.mvn_param
+        return [E.mvn(bottoms[0], mp.normalize_variance, mp.across_channels)]
+
+
+class SilenceLayer(Layer):
+    TYPE = "SILENCE"
+
+    def setup(self, bottom_shapes):
+        return []
+
+    def apply(self, params, bottoms, ctx):
+        return []
+
+
+class SoftmaxLayer(Layer):
+    TYPE = "SOFTMAX"
+
+    def setup(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, params, bottoms, ctx):
+        return [L.softmax(bottoms[0], axis=1)]
+
+
+class ArgMaxLayer(Layer):
+    TYPE = "ARGMAX"
+
+    def setup(self, bottom_shapes):
+        ap = self.lp.argmax_param
+        n = bottom_shapes[0][0]
+        return [(n, 2 if ap.out_max_val else 1, ap.top_k, 1)]
+
+    def apply(self, params, bottoms, ctx):
+        ap = self.lp.argmax_param
+        return [L.argmax(bottoms[0], ap.top_k, ap.out_max_val)]
+
+
+# --------------------------------------------------------------------------- #
+# Losses and metrics
+# --------------------------------------------------------------------------- #
+
+class _ScalarTopLayer(Layer):
+    def setup(self, bottom_shapes):
+        return [()]
+
+
+class SoftmaxLossLayer(Layer):
+    TYPE = "SOFTMAX_LOSS"
+
+    def setup(self, bottom_shapes):
+        if len(self.lp.top) >= 2:
+            return [(), bottom_shapes[0]]
+        return [()]
+
+    def apply(self, params, bottoms, ctx):
+        loss = L.softmax_loss(bottoms[0], bottoms[1])
+        if len(self.lp.top) >= 2:
+            return [loss, L.softmax(bottoms[0], axis=1)]
+        return [loss]
+
+
+class EuclideanLossLayer(_ScalarTopLayer):
+    TYPE = "EUCLIDEAN_LOSS"
+
+    def apply(self, params, bottoms, ctx):
+        return [L.euclidean_loss(bottoms[0], bottoms[1])]
+
+
+class HingeLossLayer(_ScalarTopLayer):
+    TYPE = "HINGE_LOSS"
+
+    def apply(self, params, bottoms, ctx):
+        return [L.hinge_loss(bottoms[0], bottoms[1],
+                             self.lp.hinge_loss_param.norm)]
+
+
+class MultinomialLogisticLossLayer(_ScalarTopLayer):
+    TYPE = "MULTINOMIAL_LOGISTIC_LOSS"
+
+    def apply(self, params, bottoms, ctx):
+        return [L.multinomial_logistic_loss(bottoms[0], bottoms[1])]
+
+
+class SigmoidCrossEntropyLossLayer(_ScalarTopLayer):
+    TYPE = "SIGMOID_CROSS_ENTROPY_LOSS"
+
+    def apply(self, params, bottoms, ctx):
+        return [L.sigmoid_cross_entropy_loss(bottoms[0], bottoms[1])]
+
+
+class InfogainLossLayer(_ScalarTopLayer):
+    TYPE = "INFOGAIN_LOSS"
+
+    def setup(self, bottom_shapes):
+        src = self.lp.infogain_loss_param.source
+        if len(bottom_shapes) >= 3:
+            self.H = None  # provided as third bottom
+        elif src:
+            from ..proto.wire import read_blob_file
+            if src.endswith(".npy"):
+                self.H = np.load(src).astype(np.float32)
+            else:
+                self.H = read_blob_file(src).reshape(-1)
+            dim = int(np.prod(bottom_shapes[0][1:]))
+            self.H = np.asarray(self.H, np.float32).reshape(dim, dim)
+        else:
+            raise ValueError(f"{self.name}: infogain needs a source or 3rd bottom")
+        return [()]
+
+    def apply(self, params, bottoms, ctx):
+        H = bottoms[2] if len(bottoms) >= 3 else jnp.asarray(self.H)
+        if H.ndim > 2:
+            H = H.reshape(H.shape[-2], H.shape[-1]) if H.shape[-1] == H.shape[-2] \
+                else H.reshape(int(H.size ** 0.5), -1)
+        return [L.infogain_loss(bottoms[0], bottoms[1], H)]
+
+
+class ContrastiveLossLayer(_ScalarTopLayer):
+    TYPE = "CONTRASTIVE_LOSS"
+
+    def apply(self, params, bottoms, ctx):
+        return [L.contrastive_loss(bottoms[0], bottoms[1], bottoms[2],
+                                   self.lp.contrastive_loss_param.margin)]
+
+
+class AccuracyLayer(_ScalarTopLayer):
+    TYPE = "ACCURACY"
+
+    def apply(self, params, bottoms, ctx):
+        return [L.accuracy(bottoms[0], bottoms[1],
+                           self.lp.accuracy_param.top_k)]
+
+
+# --------------------------------------------------------------------------- #
+# Data layers
+# --------------------------------------------------------------------------- #
+
+class _SourceLayer(Layer):
+    """Tops are provided externally by the data pipeline (host side)."""
+
+    def setup(self, bottom_shapes):
+        raise RuntimeError(f"{self.TYPE} tops must come from the data pipeline")
+
+    def apply(self, params, bottoms, ctx):
+        raise RuntimeError(f"{self.TYPE} is not applied in-graph")
+
+
+class DataLayer(_SourceLayer):
+    TYPE = "DATA"
+
+
+class ImageDataLayer(_SourceLayer):
+    TYPE = "IMAGE_DATA"
+
+
+class HDF5DataLayer(_SourceLayer):
+    TYPE = "HDF5_DATA"
+
+
+class WindowDataLayer(_SourceLayer):
+    TYPE = "WINDOW_DATA"
+
+
+class MemoryDataLayer(_SourceLayer):
+    TYPE = "MEMORY_DATA"
+
+
+class DummyDataLayer(Layer):
+    TYPE = "DUMMY_DATA"
+
+    def setup(self, bottom_shapes):
+        dp = self.lp.dummy_data_param
+        n_top = len(self.lp.top)
+
+        def dim(values, i):
+            if len(values) == 1:
+                return values[0]
+            return values[i]
+
+        self.shapes = [
+            (dim(dp.num, i), dim(dp.channels, i), dim(dp.height, i),
+             dim(dp.width, i))
+            for i in range(n_top)
+        ]
+        fillers = dp.data_filler or [FillerParameter()]
+        self.fillers = [fillers[i] if len(fillers) > 1 else fillers[0]
+                        for i in range(n_top)]
+        return list(self.shapes)
+
+    def apply(self, params, bottoms, ctx):
+        outs = []
+        rng = ctx.layer_rng(self.index)
+        for i, (shape, f) in enumerate(zip(self.shapes, self.fillers)):
+            pdef = ParamDef(name=f"top{i}", shape=shape, filler=f)
+            key = (jax.random.fold_in(rng, i) if rng is not None
+                   else jax.random.PRNGKey(i))
+            outs.append(fill(key, pdef))
+        return outs
+
+
+class HDF5OutputLayer(Layer):
+    TYPE = "HDF5_OUTPUT"
+
+    def setup(self, bottom_shapes):
+        return []
+
+    def apply(self, params, bottoms, ctx):
+        # Side-effecting IO cannot live in the traced graph; the engine dumps
+        # the bottoms of HDF5_OUTPUT layers from the blobs dict after each step
+        # (runtime/engine.py), mirroring hdf5_output_layer.cpp.
+        return []
+
+
+REGISTRY: Dict[str, type] = {
+    cls.TYPE: cls
+    for cls in [
+        ConvolutionLayer, InnerProductLayer, PoolingLayer, LRNLayer,
+        Im2colLayer, ReLULayer, SigmoidLayer, TanHLayer, BNLLLayer,
+        AbsValLayer, PowerLayer, ThresholdLayer, DropoutLayer, FlattenLayer,
+        ConcatLayer, SliceLayer, SplitLayer, EltwiseLayer, MVNLayer,
+        SilenceLayer, SoftmaxLayer, ArgMaxLayer, SoftmaxLossLayer,
+        EuclideanLossLayer, HingeLossLayer, MultinomialLogisticLossLayer,
+        SigmoidCrossEntropyLossLayer, InfogainLossLayer, ContrastiveLossLayer,
+        AccuracyLayer, DataLayer, ImageDataLayer, HDF5DataLayer,
+        WindowDataLayer, MemoryDataLayer, DummyDataLayer, HDF5OutputLayer,
+    ]
+}
+
+
+def create_layer(lp: LayerParameter, phase: str, index: int) -> Layer:
+    t = lp.canonical_type()
+    if t not in REGISTRY:
+        raise ValueError(f"layer {lp.name!r}: unsupported type {t}")
+    return REGISTRY[t](lp, phase, index)

@@ -1,0 +1,214 @@
+"""Net: prototxt-defined DAG -> pure traced forward function.
+
+The TPU-native counterpart of the reference's ``Net<Dtype>``
+(``src/caffe/net.cpp``): builds the layer graph from a ``NetParameter`` with
+phase filtering (``Net::FilterNet``, net.cpp:366), infers every blob shape,
+collects parameter definitions, and exposes
+
+    init(rng)                      -> params pytree
+    apply(params, inputs, ...)     -> NetOutputs(loss, outputs, blobs)
+
+``apply`` is pure and jit-able; backward is ``jax.grad(apply)`` — there is no
+separate backward graph, no InsertSplits (multi-consumer blobs are natural in
+a functional graph), and no PS-table plumbing (parameter placement is a
+sharding annotation, handled in ``poseidon_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..proto.messages import NetParameter, NetState, LayerParameter
+from .blob import ParamDef
+from .fillers import fill
+from .layers import (ApplyCtx, DATA_SOURCE_TYPES, Layer, create_layer)
+
+Shape = Tuple[int, ...]
+
+
+def filter_net(net_param: NetParameter, state: NetState) -> List[LayerParameter]:
+    """Phase/level/stage filtering with the reference's include/exclude rules."""
+    out = []
+    for lp in net_param.layers:
+        if lp.include and lp.exclude:
+            raise ValueError(f"layer {lp.name!r}: specify include or exclude, not both")
+        if lp.include:
+            keep = any(r.matches(state) for r in lp.include)
+        elif lp.exclude:
+            keep = not any(r.matches(state) for r in lp.exclude)
+        else:
+            keep = True
+        if keep:
+            out.append(lp)
+    return out
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class NetOutputs:
+    loss: jax.Array
+    outputs: Dict[str, jax.Array]
+    blobs: Dict[str, jax.Array] = field(default_factory=dict)
+
+
+class Net:
+    def __init__(
+        self,
+        net_param: NetParameter,
+        phase: str = "TRAIN",
+        source_shapes: Optional[Dict[str, Shape]] = None,
+        level: int = 0,
+        stages: Sequence[str] = (),
+    ):
+        self.net_param = net_param
+        self.phase = phase
+        self.state = NetState(phase=phase, level=level, stage=list(stages))
+        self.name = net_param.name
+
+        selected = filter_net(net_param, self.state)
+        self.source_layer_params: List[LayerParameter] = []
+        self.layers: List[Layer] = []
+        blob_shapes: Dict[str, Shape] = {}
+
+        # Explicit net inputs (deploy-style nets).
+        if net_param.input:
+            dims = net_param.input_dim
+            if len(dims) != 4 * len(net_param.input):
+                raise ValueError("input_dim must have 4 entries per input")
+            for i, name in enumerate(net_param.input):
+                blob_shapes[name] = tuple(dims[4 * i:4 * i + 4])
+
+        # Any supplied source shape is an external input (tops of data layers
+        # when the net has them, or direct feeds for programmatic nets).
+        source_shapes = dict(source_shapes or {})
+        for name, shape in source_shapes.items():
+            blob_shapes[name] = tuple(shape)
+        for idx, lp in enumerate(selected):
+            t = lp.canonical_type()
+            if t in DATA_SOURCE_TYPES:
+                self.source_layer_params.append(lp)
+                for top in lp.top:
+                    if top not in source_shapes:
+                        raise ValueError(
+                            f"data layer {lp.name!r}: shape for top {top!r} "
+                            f"must be supplied via source_shapes")
+                    blob_shapes[top] = tuple(source_shapes[top])
+                continue
+            layer = create_layer(lp, phase, idx)
+            bottoms = []
+            for b in lp.bottom:
+                if b not in blob_shapes:
+                    raise ValueError(f"layer {lp.name!r}: unknown bottom {b!r}")
+                bottoms.append(blob_shapes[b])
+            tops = layer.setup(bottoms)
+            if len(tops) != len(lp.top):
+                raise ValueError(
+                    f"layer {lp.name!r}: produced {len(tops)} tops, "
+                    f"declared {len(lp.top)}")
+            for name, shape in zip(lp.top, tops):
+                blob_shapes[name] = tuple(int(d) for d in shape)
+            self.layers.append(layer)
+
+        self.blob_shapes = blob_shapes
+        seen = set(net_param.input)
+        self.input_names: List[str] = list(net_param.input)
+        for name in list(source_shapes) + [
+                t for lp in self.source_layer_params for t in lp.top]:
+            if name not in seen:
+                seen.add(name)
+                self.input_names.append(name)
+
+        produced, consumed = [], set()
+        for layer in self.layers:
+            for b in layer.lp.bottom:
+                consumed.add(b)
+            for t in layer.lp.top:
+                if t not in produced:
+                    produced.append(t)
+        self.output_names = [t for t in produced if t not in consumed]
+
+        self.param_defs: Dict[str, List[ParamDef]] = {
+            layer.name: layer.params for layer in self.layers if layer.params}
+        self._layer_by_name = {l.name: l for l in self.layers}
+
+    # ------------------------------------------------------------------ #
+    def init(self, rng: jax.Array) -> Dict[str, Dict[str, jax.Array]]:
+        params: Dict[str, Dict[str, jax.Array]] = {}
+        for li, (lname, defs) in enumerate(sorted(self.param_defs.items())):
+            lparams = {}
+            for pi, pdef in enumerate(defs):
+                key = jax.random.fold_in(jax.random.fold_in(rng, li), pi)
+                lparams[pdef.name] = fill(key, pdef)
+            params[lname] = lparams
+        return params
+
+    def param_count(self) -> int:
+        return sum(p.count for defs in self.param_defs.values() for p in defs)
+
+    # ------------------------------------------------------------------ #
+    def apply(
+        self,
+        params: Dict[str, Dict[str, jax.Array]],
+        inputs: Dict[str, jax.Array],
+        train: Optional[bool] = None,
+        rng: Optional[jax.Array] = None,
+        comm=None,
+        keep_blobs: bool = False,
+    ) -> NetOutputs:
+        if train is None:
+            train = self.phase == "TRAIN"
+        ctx = ApplyCtx(train=train, rng=rng, comm=comm)
+        blobs: Dict[str, jax.Array] = dict(inputs)
+        loss = jnp.zeros((), jnp.float32)
+        outputs: Dict[str, jax.Array] = {}
+        for layer in self.layers:
+            lp = layer.lp
+            bottoms = [blobs[b] for b in lp.bottom]
+            tops = layer.apply(params.get(layer.name, {}), bottoms, ctx)
+            weights = layer.loss_weights(len(tops))
+            for name, val, w in zip(lp.top, tops, weights):
+                blobs[name] = val
+                if w:
+                    # Caffe sums the whole top blob into the objective when a
+                    # loss_weight is set on a non-scalar top (net.cpp).
+                    loss = loss + w * jnp.sum(val.astype(jnp.float32))
+        for name in self.output_names:
+            outputs[name] = blobs[name]
+        return NetOutputs(loss=loss, outputs=outputs,
+                          blobs=blobs if keep_blobs else {})
+
+    # ------------------------------------------------------------------ #
+    def load_weights(self, params, layer_weights: Dict[str, List[np.ndarray]],
+                     strict: bool = False):
+        """CopyTrainedLayersFrom (net.cpp): merge {layer: [blob arrays]} by
+        name/order; unknown layers ignored unless strict."""
+        new_params = {k: dict(v) for k, v in params.items()}
+        for lname, arrays in layer_weights.items():
+            if lname not in self.param_defs:
+                if strict:
+                    raise KeyError(f"no such param layer {lname!r}")
+                continue
+            defs = self.param_defs[lname]
+            if len(arrays) != len(defs):
+                raise ValueError(
+                    f"{lname}: {len(arrays)} blobs in file, {len(defs)} in net")
+            for pdef, arr in zip(defs, arrays):
+                arr = np.asarray(arr, np.float32)
+                if int(arr.size) != pdef.count:
+                    raise ValueError(
+                        f"{lname}/{pdef.name}: count mismatch "
+                        f"{arr.size} vs {pdef.count}")
+                new_params[lname][pdef.name] = jnp.asarray(
+                    arr.reshape(pdef.shape))
+        return new_params
+
+    def export_weights(self, params) -> Dict[str, List[np.ndarray]]:
+        return {
+            lname: [np.asarray(params[lname][p.name]) for p in defs]
+            for lname, defs in self.param_defs.items()
+        }

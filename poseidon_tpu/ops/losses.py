@@ -1,0 +1,121 @@
+"""Loss and metric ops with the reference's exact normalization conventions.
+
+Every loss is normalized the way the corresponding reference layer normalizes
+(``src/caffe/layers/*_loss_layer.cpp``), so loss curves are directly comparable
+to PMLS-Caffe logs:
+
+- softmax_loss: -mean over (num * spatial) of log prob[label], probs clamped
+  at FLT_MIN                              (softmax_loss_layer.cpp:47-56)
+- multinomial_logistic: same but /num only, clamp 1e-20
+- euclidean: sum((a-b)^2) / (2*num)
+- hinge L1/L2: sum(max(0, 1 +/- score)) / num
+- infogain: -sum H[label,j] log(p_j) / num
+- sigmoid CE: -sum[x t - log(1+e^x)] / num (stable form)
+- contrastive: (y d^2 + (1-y) max(margin - d^2, 0)) / (2*num)
+- accuracy: top-k hit rate (a metric, not differentiable; gradients stopped)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_FLT_MIN = float(np.finfo(np.float32).tiny)
+
+
+def softmax(x, axis: int = 1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax_loss(logits, labels):
+    """logits (N, C, H, W) or (N, C); labels (N, H, W)/(N,) integer."""
+    if logits.ndim == 2:
+        logits = logits[:, :, None, None]
+    if labels.ndim == 1:
+        labels = labels[:, None, None]
+    labels = labels.reshape(labels.shape[0], *logits.shape[2:]).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=1)
+    # clamp to log(FLT_MIN) like the reference clamps prob at FLT_MIN
+    picked = jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    picked = jnp.maximum(picked, jnp.log(_FLT_MIN))
+    n, h, w = picked.shape[0], picked.shape[1], picked.shape[2]
+    return -jnp.sum(picked) / (n * h * w)
+
+
+def multinomial_logistic_loss(probs, labels):
+    labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
+    p = probs.reshape(probs.shape[0], -1)
+    picked = jnp.take_along_axis(p, labels[:, None], axis=1)[:, 0]
+    return -jnp.mean(jnp.log(jnp.maximum(picked, 1e-20)))
+
+
+def euclidean_loss(a, b):
+    d = a - b
+    return jnp.sum(d * d) / (2.0 * a.shape[0])
+
+
+def hinge_loss(scores, labels, norm: str = "L1"):
+    n = scores.shape[0]
+    s = scores.reshape(n, -1)
+    labels = labels.reshape(n).astype(jnp.int32)
+    sign = jnp.ones_like(s).at[jnp.arange(n), labels].set(-1.0)
+    margins = jnp.maximum(0.0, 1.0 + sign * s)
+    if norm == "L1":
+        return jnp.sum(margins) / n
+    if norm == "L2":
+        return jnp.sum(margins * margins) / n
+    raise ValueError(f"unknown hinge norm {norm!r}")
+
+
+def infogain_loss(probs, labels, H):
+    n = probs.shape[0]
+    p = probs.reshape(n, -1)
+    labels = labels.reshape(n).astype(jnp.int32)
+    logp = jnp.log(jnp.maximum(p, 1e-20))
+    rows = H[labels]  # (n, dim)
+    return -jnp.sum(rows * logp) / n
+
+
+def sigmoid_cross_entropy_loss(logits, targets):
+    n = logits.shape[0]
+    x = logits.reshape(n, -1)
+    t = targets.reshape(n, -1)
+    # -[x*t - log(1 + exp(x))] in the overflow-stable form the reference uses
+    # (sigmoid_cross_entropy_loss_layer.cpp)
+    loss = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return jnp.sum(loss) / n
+
+
+def contrastive_loss(a, b, y, margin: float):
+    n = a.shape[0]
+    d = (a - b).reshape(n, -1)
+    dist_sq = jnp.sum(d * d, axis=1)
+    y = y.reshape(n)
+    per = jnp.where(y > 0, dist_sq, jnp.maximum(margin - dist_sq, 0.0))
+    return jnp.sum(per) / (2.0 * n)
+
+
+def accuracy(scores, labels, top_k: int = 1):
+    n = scores.shape[0]
+    s = scores.reshape(n, -1)
+    labels = labels.reshape(n).astype(jnp.int32)
+    s = jax.lax.stop_gradient(s)
+    if top_k == 1:
+        hit = jnp.argmax(s, axis=1) == labels
+    else:
+        _, idx = jax.lax.top_k(s, top_k)
+        hit = jnp.any(idx == labels[:, None], axis=1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+def argmax(scores, top_k: int = 1, out_max_val: bool = False):
+    n = scores.shape[0]
+    s = scores.reshape(n, -1)
+    vals, idx = jax.lax.top_k(s, top_k)
+    if out_max_val:
+        # (N, 2, top_k, 1): channel 0 = indices, channel 1 = values (argmax_layer.cpp)
+        out = jnp.stack([idx.astype(scores.dtype), vals], axis=1)
+        return out[:, :, :, None]
+    return idx.astype(scores.dtype)[:, None, :, None]

@@ -1,0 +1,215 @@
+"""Heavy NN ops: convolution, pooling, LRN, inner product, im2col.
+
+These replace the reference's CUDA kernels (``src/caffe/layers/*.cu``,
+``src/caffe/util/im2col.cu``) with XLA-native formulations: convolution and
+inner product lower directly onto the MXU via ``lax.conv_general_dilated`` /
+``lax.dot_general`` (no explicit im2col on the compute path), pooling via
+``lax.reduce_window`` with Caffe's exact output-size and window-clipping rules,
+and LRN as a fused elementwise + windowed-sum expression XLA folds into
+neighboring ops.
+
+Numerical semantics follow the reference:
+- conv output size: floor((in + 2*pad - k)/stride) + 1        (conv_layer.cpp)
+- pool output size: ceil((in + 2*pad - k)/stride) + 1, minus one if the last
+  window would start in the padding                           (pooling_layer.cpp:72-88)
+- AVE pooling divides by the window size clipped to the *padded* extent
+  (pooling_layer.cpp:170-180)
+- LRN across-channels: y = x * (1 + alpha/n * sum_window x^2)^-beta
+  (lrn_layer.cpp:124-155); within-channel uses AVE-pooled squares with
+  scale = (1 + alpha * avgpool(x^2))^-beta                    (lrn_layer.cpp:22-72)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import matmul_precision, policy
+
+# --------------------------------------------------------------------------- #
+# Convolution
+# --------------------------------------------------------------------------- #
+
+
+def conv_out_size(in_size: int, kernel: int, stride: int, pad: int) -> int:
+    return (in_size + 2 * pad - kernel) // stride + 1
+
+
+def conv2d(
+    x: jax.Array,
+    w: jax.Array,
+    b: Optional[jax.Array],
+    stride: Tuple[int, int],
+    pad: Tuple[int, int],
+    group: int = 1,
+) -> jax.Array:
+    """NCHW convolution; w is OIHW with I = C/group."""
+    p = policy()
+    y = lax.conv_general_dilated(
+        x.astype(p.compute_dtype),
+        w.astype(p.compute_dtype),
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=group,
+        preferred_element_type=p.accum_dtype,
+        precision=matmul_precision(),
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1).astype(y.dtype)
+    return y
+
+
+def im2col(
+    x: jax.Array, kernel: Tuple[int, int], stride: Tuple[int, int], pad: Tuple[int, int]
+) -> jax.Array:
+    """Patch extraction (the reference's IM2COL layer, util/im2col.cpp).
+
+    Returns (N, C*kh*kw, out_h, out_w) matching Caffe's column layout.
+    """
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=kernel,
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return patches
+
+
+# --------------------------------------------------------------------------- #
+# Pooling
+# --------------------------------------------------------------------------- #
+
+
+def pool_out_size(in_size: int, kernel: int, stride: int, pad: int) -> int:
+    out = int(math.ceil((in_size + 2 * pad - kernel) / stride)) + 1
+    if pad > 0 and (out - 1) * stride >= in_size + pad:
+        out -= 1
+    return out
+
+
+def _pool_dims(x, kernel, stride, pad):
+    h, w = x.shape[2], x.shape[3]
+    return h, w, pool_out_size(h, kernel[0], stride[0], pad[0]), pool_out_size(
+        w, kernel[1], stride[1], pad[1]
+    )
+
+
+def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine):
+    """Pool by combining k_h*k_w strided slices of the padded input.
+
+    Equivalent to reduce_window but built from slice+elementwise ops, which
+    (unlike generic reduce_window in current JAX) differentiate cleanly inside
+    shard_map; XLA fuses the slice chain back into one windowed pass.
+    """
+    n, c, h, w = x.shape
+    hi_h = max((oh - 1) * stride[0] + kernel[0] - pad[0] - h, 0)
+    hi_w = max((ow - 1) * stride[1] + kernel[1] - pad[1] - w, 0)
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pad[0], hi_h), (pad[1], hi_w)],
+                 constant_values=fill)
+    out = None
+    for dh in range(kernel[0]):
+        for dw in range(kernel[1]):
+            sl = lax.slice(
+                xp, (0, 0, dh, dw),
+                (n, c, dh + (oh - 1) * stride[0] + 1,
+                 dw + (ow - 1) * stride[1] + 1),
+                (1, 1, stride[0], stride[1]))
+            out = sl if out is None else combine(out, sl)
+    return out
+
+
+def max_pool(x, kernel, stride, pad):
+    h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
+    return _window_reduce(x, kernel, stride, pad, oh, ow,
+                          -jnp.inf, jnp.maximum)
+
+
+def ave_pool(x, kernel, stride, pad):
+    h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
+    summed = _window_reduce(x, kernel, stride, pad, oh, ow, 0.0,
+                            lambda a, b: a + b)
+    # Caffe's divisor: window clipped to the padded extent [start, in+pad),
+    # where start may be negative (pooling_layer.cpp:170-180). Static per
+    # position, so compute host-side.
+    def divisors(n_out, stride_, pad_, kernel_, in_):
+        starts = np.arange(n_out) * stride_ - pad_
+        ends = np.minimum(starts + kernel_, in_ + pad_)
+        return (ends - starts).astype(np.float32)
+
+    dh = divisors(oh, stride[0], pad[0], kernel[0], h)
+    dw = divisors(ow, stride[1], pad[1], kernel[1], w)
+    denom = jnp.asarray(np.outer(dh, dw), x.dtype)
+    return summed / denom
+
+
+def global_ave_pool(x):
+    return jnp.mean(x, axis=(2, 3), keepdims=True)
+
+
+def stochastic_pool(x, kernel, stride, pad, rng, train: bool):
+    """STOCHASTIC pooling (enum present in the reference; CPU impl was
+    NOT_IMPLEMENTED, GPU trains by prob-weighted sampling, tests with the
+    prob-weighted average — pooling_layer.cu). x must be non-negative."""
+    h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
+    if pad != (0, 0):
+        raise NotImplementedError("stochastic pooling with padding")
+    add = lambda a, b: a + b
+    sum_x = _window_reduce(x, kernel, stride, pad, oh, ow, 0.0, add)
+    sum_x2 = _window_reduce(x * x, kernel, stride, pad, oh, ow, 0.0, add)
+    # Prob-weighted average in both phases (the reference's test path; exact
+    # multinomial sampling at train time would break cross-replica
+    # determinism).
+    return sum_x2 / jnp.maximum(sum_x, jnp.finfo(jnp.float32).tiny)
+
+
+# --------------------------------------------------------------------------- #
+# LRN
+# --------------------------------------------------------------------------- #
+
+
+def lrn_across_channels(x, local_size: int, alpha: float, beta: float, k: float = 1.0):
+    pre_pad = (local_size - 1) // 2
+    post_pad = local_size - pre_pad - 1
+    n, c, h, w = x.shape
+    sq = jnp.pad(x * x, [(0, 0), (pre_pad, post_pad), (0, 0), (0, 0)])
+    windowed = None
+    for dc in range(local_size):
+        sl = lax.slice(sq, (0, dc, 0, 0), (n, dc + c, h, w))
+        windowed = sl if windowed is None else windowed + sl
+    scale = k + (alpha / local_size) * windowed
+    return x * scale ** (-beta)
+
+
+def lrn_within_channel(x, local_size: int, alpha: float, beta: float):
+    pre_pad = (local_size - 1) // 2
+    pooled = ave_pool(x * x, (local_size, local_size), (1, 1), (pre_pad, pre_pad))
+    scale = 1.0 + alpha * pooled
+    return x * scale ** (-beta)
+
+
+# --------------------------------------------------------------------------- #
+# Inner product
+# --------------------------------------------------------------------------- #
+
+
+def inner_product(x: jax.Array, w: jax.Array, b: Optional[jax.Array]) -> jax.Array:
+    """x: (N, ...) flattened to (N, K); w: (M, K) as Caffe stores it."""
+    p = policy()
+    x2 = x.reshape(x.shape[0], -1)
+    y = lax.dot_general(
+        x2.astype(p.compute_dtype),
+        w.astype(p.compute_dtype),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=p.accum_dtype,
+        precision=matmul_precision(),
+    )
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y

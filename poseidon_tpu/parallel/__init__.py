@@ -1,0 +1,9 @@
+from .mesh import DATA_AXIS, batch_sharding, make_mesh, replicated  # noqa: F401
+from .strategies import (  # noqa: F401
+    CommConfig, CommContext, DENSE, LOCAL, SFB, TOPK, auto_strategies,
+    topk_compress,
+)
+from .trainer import (  # noqa: F401
+    TrainState, build_eval_step, build_ssp_train_step, build_train_step,
+    init_ssp_state, init_train_state, param_mults,
+)

@@ -1,0 +1,42 @@
+"""Device mesh construction — the TPU analog of the reference's cluster topology.
+
+Where the reference enumerates machines from a hostfile and threads per GPU
+(``ps/src/petuum_ps/thread/context.hpp``, ``src/caffe/common.cpp:52-185``), the
+TPU runtime's topology is a ``jax.sharding.Mesh``. The parity scope is one
+"data" axis (pure data parallelism, §2.3 of SURVEY.md); helper supports extra
+axes for model/pipeline experiments.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    num_devices: Optional[int] = None,
+    axes: Sequence[str] = (DATA_AXIS,),
+    shape: Optional[Tuple[int, ...]] = None,
+) -> Mesh:
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    n = len(devices)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axes) - 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    return Mesh(np.asarray(devices).reshape(shape), tuple(axes))
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,5 @@
+from .prototxt import Node, PrototxtError, parse, parse_file, dumps  # noqa: F401
+from .messages import (  # noqa: F401
+    LayerParameter, NetParameter, NetState, SolverParameter,
+    load_net, load_net_from_string, load_solver, load_solver_from_string,
+)

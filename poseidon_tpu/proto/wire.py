@@ -1,0 +1,295 @@
+"""Minimal protobuf *wire format* codec (proto2), no protoc required.
+
+Used for binary compatibility with the reference's serialized artifacts:
+``Datum`` records inside LMDB/LevelDB databases, ``BlobProto`` mean files,
+``.caffemodel`` nets and ``.solverstate`` snapshots
+(schema: ``/root/reference/src/caffe/proto/caffe.proto``).
+
+Only the wire-level primitives plus hand-rolled (de)serializers for the handful
+of messages we exchange with Caffe-format files.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+WIRETYPE_VARINT = 0
+WIRETYPE_64BIT = 1
+WIRETYPE_LEN = 2
+WIRETYPE_32BIT = 5
+
+
+class WireError(ValueError):
+    pass
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise WireError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise WireError("varint too long")
+
+
+def _write_varint(out: bytearray, value: int) -> None:
+    if value < 0:
+        value &= (1 << 64) - 1
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return
+
+
+def iter_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a serialized message.
+
+    LEN fields yield raw bytes; VARINT yields int; 32/64-bit yield raw ints.
+    """
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        fnum, wtype = key >> 3, key & 7
+        if wtype == WIRETYPE_VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wtype == WIRETYPE_64BIT:
+            val = int.from_bytes(buf[pos:pos + 8], "little")
+            pos += 8
+        elif wtype == WIRETYPE_LEN:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            if len(val) != ln:
+                raise WireError("truncated length-delimited field")
+            pos += ln
+        elif wtype == WIRETYPE_32BIT:
+            val = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+        else:
+            raise WireError(f"unsupported wire type {wtype}")
+        yield fnum, wtype, val
+
+
+def _as_float(wtype: int, val) -> float:
+    if wtype == WIRETYPE_32BIT:
+        return struct.unpack("<f", val.to_bytes(4, "little"))[0]
+    raise WireError("expected 32-bit float field")
+
+
+def _packed_floats(val: bytes) -> np.ndarray:
+    return np.frombuffer(val, dtype="<f4")
+
+
+def _emit_tag(out: bytearray, fnum: int, wtype: int) -> None:
+    _write_varint(out, (fnum << 3) | wtype)
+
+
+def emit_varint_field(out: bytearray, fnum: int, value: int) -> None:
+    _emit_tag(out, fnum, WIRETYPE_VARINT)
+    _write_varint(out, value)
+
+
+def emit_bytes_field(out: bytearray, fnum: int, value: bytes) -> None:
+    _emit_tag(out, fnum, WIRETYPE_LEN)
+    _write_varint(out, len(value))
+    out.extend(value)
+
+
+def emit_packed_floats(out: bytearray, fnum: int, values: np.ndarray) -> None:
+    emit_bytes_field(out, fnum, np.asarray(values, dtype="<f4").tobytes())
+
+
+def emit_float_field(out: bytearray, fnum: int, value: float) -> None:
+    _emit_tag(out, fnum, WIRETYPE_32BIT)
+    out.extend(struct.pack("<f", value))
+
+
+# --------------------------------------------------------------------------- #
+# Datum
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class Datum:
+    channels: int = 0
+    height: int = 0
+    width: int = 0
+    data: bytes = b""
+    label: int = 0
+    float_data: Optional[np.ndarray] = None
+
+    def to_array(self) -> np.ndarray:
+        """(C, H, W) float32 array (uint8 bytes NOT mean-subtracted/scaled)."""
+        if self.float_data is not None and len(self.float_data):
+            return np.asarray(self.float_data, np.float32).reshape(
+                self.channels, self.height, self.width)
+        arr = np.frombuffer(self.data, dtype=np.uint8)
+        return arr.reshape(self.channels, self.height, self.width).astype(np.float32)
+
+
+def decode_datum(buf: bytes) -> Datum:
+    d = Datum()
+    floats: List[float] = []
+    packed: Optional[np.ndarray] = None
+    for fnum, wtype, val in iter_fields(buf):
+        if fnum == 1:
+            d.channels = val
+        elif fnum == 2:
+            d.height = val
+        elif fnum == 3:
+            d.width = val
+        elif fnum == 4:
+            d.data = val
+        elif fnum == 5:
+            d.label = val
+        elif fnum == 6:
+            if wtype == WIRETYPE_LEN:
+                packed = _packed_floats(val)
+            else:
+                floats.append(_as_float(wtype, val))
+    if packed is not None:
+        d.float_data = packed
+    elif floats:
+        d.float_data = np.asarray(floats, np.float32)
+    return d
+
+
+def encode_datum(d: Datum) -> bytes:
+    out = bytearray()
+    emit_varint_field(out, 1, d.channels)
+    emit_varint_field(out, 2, d.height)
+    emit_varint_field(out, 3, d.width)
+    if d.data:
+        emit_bytes_field(out, 4, d.data)
+    emit_varint_field(out, 5, d.label)
+    if d.float_data is not None and len(d.float_data):
+        emit_packed_floats(out, 6, d.float_data)
+    return bytes(out)
+
+
+# --------------------------------------------------------------------------- #
+# BlobProto
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class BlobProtoWire:
+    num: int = 0
+    channels: int = 0
+    height: int = 0
+    width: int = 0
+    data: Optional[np.ndarray] = None
+    diff: Optional[np.ndarray] = None
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int]:
+        return (self.num, self.channels, self.height, self.width)
+
+    def to_array(self) -> np.ndarray:
+        return np.asarray(self.data, np.float32).reshape(self.shape)
+
+
+def decode_blob(buf: bytes) -> BlobProtoWire:
+    b = BlobProtoWire()
+    data_parts: List[np.ndarray] = []
+    diff_parts: List[np.ndarray] = []
+    for fnum, wtype, val in iter_fields(buf):
+        if fnum == 1:
+            b.num = val
+        elif fnum == 2:
+            b.channels = val
+        elif fnum == 3:
+            b.height = val
+        elif fnum == 4:
+            b.width = val
+        elif fnum == 5:
+            data_parts.append(_packed_floats(val) if wtype == WIRETYPE_LEN
+                              else np.asarray([_as_float(wtype, val)], np.float32))
+        elif fnum == 6:
+            diff_parts.append(_packed_floats(val) if wtype == WIRETYPE_LEN
+                              else np.asarray([_as_float(wtype, val)], np.float32))
+    if data_parts:
+        b.data = np.concatenate(data_parts)
+    if diff_parts:
+        b.diff = np.concatenate(diff_parts)
+    return b
+
+
+def encode_blob(arr: np.ndarray, diff: Optional[np.ndarray] = None) -> bytes:
+    from ..core.blob import nchw
+    shape = nchw(tuple(arr.shape))
+    out = bytearray()
+    emit_varint_field(out, 1, shape[0])
+    emit_varint_field(out, 2, shape[1])
+    emit_varint_field(out, 3, shape[2])
+    emit_varint_field(out, 4, shape[3])
+    emit_packed_floats(out, 5, np.asarray(arr, np.float32).ravel())
+    if diff is not None:
+        emit_packed_floats(out, 6, np.asarray(diff, np.float32).ravel())
+    return bytes(out)
+
+
+def read_blob_file(path: str) -> np.ndarray:
+    """Read a .binaryproto BlobProto file (e.g. an image-mean file)."""
+    with open(path, "rb") as f:
+        return decode_blob(f.read()).to_array()
+
+
+# --------------------------------------------------------------------------- #
+# NetParameter-level (.caffemodel): only name + layers{name,type,blobs} matter
+# for weight exchange.
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class LayerBlobs:
+    name: str
+    blobs: List[BlobProtoWire] = field(default_factory=list)
+
+
+def decode_caffemodel(buf: bytes) -> Dict[str, List[np.ndarray]]:
+    """Extract {layer_name: [blob arrays]} from a serialized NetParameter.
+
+    Handles the V1 `layers`(2) field; layer name is LayerParameter field 4,
+    blobs are field 6.
+    """
+    weights: Dict[str, List[np.ndarray]] = {}
+    for fnum, wtype, val in iter_fields(buf):
+        if fnum == 2 and wtype == WIRETYPE_LEN:
+            name = ""
+            blobs: List[BlobProtoWire] = []
+            for lf, lw, lv in iter_fields(val):
+                if lf == 4 and lw == WIRETYPE_LEN:
+                    name = lv.decode("utf-8", "replace")
+                elif lf == 6 and lw == WIRETYPE_LEN:
+                    blobs.append(decode_blob(lv))
+            if name:
+                weights[name] = [b.to_array() for b in blobs]
+    return weights
+
+
+def encode_caffemodel(net_name: str, layer_weights: Dict[str, List[np.ndarray]],
+                      layer_types: Optional[Dict[str, int]] = None) -> bytes:
+    """Serialize weights as a NetParameter binary that Caffe can ingest."""
+    out = bytearray()
+    emit_bytes_field(out, 1, net_name.encode())
+    for lname, blobs in layer_weights.items():
+        layer = bytearray()
+        emit_bytes_field(layer, 4, lname.encode())
+        if layer_types and lname in layer_types:
+            emit_varint_field(layer, 5, layer_types[lname])
+        for arr in blobs:
+            emit_bytes_field(layer, 6, encode_blob(arr))
+        emit_bytes_field(out, 2, bytes(layer))
+    return bytes(out)

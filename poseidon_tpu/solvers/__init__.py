@@ -1,0 +1,1 @@
+from .updates import SolverState, init_state, learning_rate, make_update_fn  # noqa: F401

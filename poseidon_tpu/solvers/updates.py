@@ -1,0 +1,112 @@
+"""Caffe-exact optimizer update rules as pure, jit-able transforms.
+
+Spec: ``/root/reference/src/caffe/solver.cpp``
+- LR policies fixed/step/exp/inv/poly    (GetLearningRate, solver.cpp:758-790)
+- SGD:      g' = g + decay*reg(w); h = m*h + local_lr*g'; w -= h
+            (ComputeUpdateValue, solver.cpp:815-900)
+- Nesterov: h' = m*h + local_lr*g'; w -= (1+m)*h' - m*h     (solver.cpp:1013)
+- AdaGrad:  h += g'^2; w -= local_lr * g' / (sqrt(h)+delta) (solver.cpp:1240)
+Regularization: L2 adds decay*w to the gradient, L1 adds decay*sign(w);
+local_lr = base_rate * lr_mult, local_decay = weight_decay * decay_mult.
+
+Iteration is carried as a traced scalar so the whole update compiles into the
+training step; LR schedules use only XLA-friendly math.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..proto.messages import SolverParameter
+
+
+def learning_rate(sp: SolverParameter, it: jax.Array) -> jax.Array:
+    it = it.astype(jnp.float32)
+    policy = sp.lr_policy
+    base = jnp.float32(sp.base_lr)
+    if policy == "fixed":
+        return base
+    if policy == "step":
+        current_step = jnp.floor(it / sp.stepsize)
+        return base * jnp.power(sp.gamma, current_step)
+    if policy == "exp":
+        return base * jnp.power(sp.gamma, it)
+    if policy == "inv":
+        return base * jnp.power(1.0 + sp.gamma * it, -sp.power)
+    if policy == "poly":
+        return base * jnp.power(1.0 - it / sp.max_iter, sp.power)
+    if policy == "sigmoid":
+        return base * (1.0 / (1.0 + jnp.exp(-sp.gamma * (it - sp.stepsize))))
+    if policy == "multistep":
+        # number of stepvalues passed so far
+        steps = jnp.asarray(sp.stepvalue, jnp.float32)
+        current_step = jnp.sum(it >= steps).astype(jnp.float32)
+        return base * jnp.power(sp.gamma, current_step)
+    raise ValueError(f"unknown lr_policy {policy!r}")
+
+
+class SolverState(NamedTuple):
+    it: jax.Array           # current iteration (traced scalar, int32)
+    history: Dict           # momentum / accumulated squared grads, like params
+
+
+def _regularized(g, w, local_decay: float, reg_type: str):
+    if local_decay == 0.0:
+        return g
+    if reg_type == "L2":
+        return g + local_decay * w
+    if reg_type == "L1":
+        return g + local_decay * jnp.sign(w)
+    raise ValueError(f"unknown regularization_type {reg_type!r}")
+
+
+def make_update_fn(sp: SolverParameter, mults: Dict[str, Dict[str, tuple]]):
+    """Build update(params, grads, state) -> (params, state).
+
+    ``mults`` maps layer -> param name -> (lr_mult, decay_mult), from the
+    net's ParamDefs (the reference's blobs_lr / weight_decay lists).
+    """
+    solver_type = sp.solver_type
+    momentum = sp.momentum
+    weight_decay = sp.weight_decay
+    reg_type = sp.regularization_type
+    delta = sp.delta
+
+    def update(params, grads, state: SolverState):
+        rate = learning_rate(sp, state.it)
+        new_params = {}
+        new_hist = {}
+        for lname, lparams in params.items():
+            new_params[lname] = {}
+            new_hist[lname] = {}
+            for pname, w in lparams.items():
+                g = grads[lname][pname]
+                lr_mult, decay_mult = mults[lname][pname]
+                local_rate = rate * lr_mult
+                local_decay = weight_decay * decay_mult
+                h = state.history[lname][pname]
+                g = _regularized(g.astype(jnp.float32), w, local_decay, reg_type)
+                if solver_type == "SGD":
+                    h_new = momentum * h + local_rate * g
+                    step = h_new
+                elif solver_type == "NESTEROV":
+                    h_new = momentum * h + local_rate * g
+                    step = (1.0 + momentum) * h_new - momentum * h
+                elif solver_type == "ADAGRAD":
+                    h_new = h + g * g
+                    step = local_rate * g / (jnp.sqrt(h_new) + delta)
+                else:
+                    raise ValueError(f"unknown solver_type {solver_type!r}")
+                new_params[lname][pname] = (w - step).astype(w.dtype)
+                new_hist[lname][pname] = h_new
+        return new_params, SolverState(it=state.it + 1, history=new_hist)
+
+    return update
+
+
+def init_state(params) -> SolverState:
+    history = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return SolverState(it=jnp.zeros((), jnp.int32), history=history)

@@ -1,0 +1,116 @@
+"""Slow, obviously-correct numpy implementations of Caffe layer semantics.
+
+These serve as the golden references for the XLA ops (the role upstream
+Caffe's deleted gtest suite played). Written directly from the behavioral
+spec in SURVEY.md / the reference sources, as naive loops.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def conv_out(h, k, s, p):
+    return (h + 2 * p - k) // s + 1
+
+
+def pool_out(h, k, s, p):
+    out = int(math.ceil((h + 2 * p - k) / s)) + 1
+    if p > 0 and (out - 1) * s >= h + p:
+        out -= 1
+    return out
+
+
+def max_pool(x, k, s, p):
+    n, c, h, w = x.shape
+    oh, ow = pool_out(h, k, s, p), pool_out(w, k, s, p)
+    y = np.full((n, c, oh, ow), -np.inf, np.float32)
+    for i in range(n):
+        for ch in range(c):
+            for ph in range(oh):
+                for pw in range(ow):
+                    hs, ws = ph * s - p, pw * s - p
+                    he, we = min(hs + k, h), min(ws + k, w)
+                    hs, ws = max(hs, 0), max(ws, 0)
+                    y[i, ch, ph, pw] = x[i, ch, hs:he, ws:we].max()
+    return y
+
+
+def ave_pool(x, k, s, p):
+    n, c, h, w = x.shape
+    oh, ow = pool_out(h, k, s, p), pool_out(w, k, s, p)
+    y = np.zeros((n, c, oh, ow), np.float32)
+    for i in range(n):
+        for ch in range(c):
+            for ph in range(oh):
+                for pw in range(ow):
+                    hs, ws = ph * s - p, pw * s - p
+                    he, we = min(hs + k, h + p), min(ws + k, w + p)
+                    pool_size = (he - hs) * (we - ws)
+                    hs2, ws2 = max(hs, 0), max(ws, 0)
+                    he2, we2 = min(he, h), min(we, w)
+                    y[i, ch, ph, pw] = x[i, ch, hs2:he2, ws2:we2].sum() / pool_size
+    return y
+
+
+def lrn_across(x, size, alpha, beta, k=1.0):
+    n, c, h, w = x.shape
+    pre = (size - 1) // 2
+    y = np.zeros_like(x)
+    for ch in range(c):
+        lo, hi = max(0, ch - pre), min(c, ch - pre + size)
+        sq = (x[:, lo:hi] ** 2).sum(axis=1)
+        scale = k + alpha / size * sq
+        y[:, ch] = x[:, ch] * scale ** (-beta)
+    return y
+
+
+def lrn_within(x, size, alpha, beta):
+    pre = (size - 1) // 2
+    pooled = ave_pool(x * x, size, 1, pre)
+    return x * (1.0 + alpha * pooled) ** (-beta)
+
+
+def conv2d(x, w, b, stride, pad, group=1):
+    n, c, h, wd = x.shape
+    o, ig, kh, kw = w.shape
+    oh, ow = conv_out(h, kh, stride, pad), conv_out(wd, kw, stride, pad)
+    xp = np.zeros((n, c, h + 2 * pad, wd + 2 * pad), np.float32)
+    xp[:, :, pad:pad + h, pad:pad + wd] = x
+    y = np.zeros((n, o, oh, ow), np.float32)
+    og = o // group
+    for i in range(n):
+        for oc in range(o):
+            g = oc // og
+            for ph in range(oh):
+                for pw in range(ow):
+                    patch = xp[i, g * ig:(g + 1) * ig,
+                               ph * stride:ph * stride + kh,
+                               pw * stride:pw * stride + kw]
+                    y[i, oc, ph, pw] = (patch * w[oc]).sum()
+            if b is not None:
+                y[i, oc] += b[oc]
+    return y
+
+
+def softmax(x, axis=1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def softmax_loss(logits, labels):
+    if logits.ndim == 2:
+        logits = logits[:, :, None, None]
+    n = logits.shape[0]
+    sp = logits.shape[2] * logits.shape[3]
+    p = softmax(logits, axis=1)
+    labels = labels.reshape(n, logits.shape[2], logits.shape[3]).astype(int)
+    total = 0.0
+    for i in range(n):
+        for hh in range(logits.shape[2]):
+            for ww in range(logits.shape[3]):
+                total -= np.log(max(p[i, labels[i, hh, ww], hh, ww],
+                                    np.finfo(np.float32).tiny))
+    return total / n / sp

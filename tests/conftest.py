@@ -1,0 +1,30 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+This is the deterministic in-process fake of the distributed substrate
+(SURVEY.md §4): every parallel strategy is unit-tested on 8 virtual devices,
+no TPU pod required.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU-tunnel plugin (if registered by sitecustomize) forces
+# jax_platforms="axon,cpu" via jax.config, which overrides the env var and
+# would route these CPU-mesh tests at a (possibly absent) TPU tunnel. Force
+# the config back to cpu-only before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng_np():
+    return np.random.RandomState(0)

@@ -1,0 +1,127 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu.core.net import Net, filter_net
+from poseidon_tpu.models import zoo
+from poseidon_tpu.proto import load_net_from_string
+from poseidon_tpu.proto.messages import NetState
+
+
+def _batch(shapes, rng):
+    data = rng.randn(*shapes["data"]).astype(np.float32)
+    label = rng.randint(0, 10, size=shapes["label"])
+    return {"data": jnp.asarray(data), "label": jnp.asarray(label)}
+
+
+def test_lenet_shapes_and_forward(rng_np):
+    net = Net(zoo.lenet(), phase="TRAIN", source_shapes=zoo.lenet_shapes(4))
+    assert net.blob_shapes["conv1"] == (4, 20, 24, 24)
+    assert net.blob_shapes["pool1"] == (4, 20, 12, 12)
+    assert net.blob_shapes["conv2"] == (4, 50, 8, 8)
+    assert net.blob_shapes["ip1"] == (4, 500)
+    assert net.blob_shapes["ip2"] == (4, 10)
+    params = net.init(jax.random.PRNGKey(0))
+    assert params["conv1"]["w"].shape == (20, 1, 5, 5)
+    assert params["ip1"]["w"].shape == (500, 800)
+    out = net.apply(params, _batch(zoo.lenet_shapes(4), rng_np),
+                    rng=jax.random.PRNGKey(1))
+    assert out.loss.shape == ()
+    assert float(out.loss) == pytest.approx(np.log(10), rel=0.3)
+
+
+def test_phase_filtering():
+    net_param = zoo.lenet(with_accuracy=True)
+    train = filter_net(net_param, NetState(phase="TRAIN"))
+    test = filter_net(net_param, NetState(phase="TEST"))
+    train_names = [l.name for l in train]
+    test_names = [l.name for l in test]
+    assert "accuracy" not in train_names
+    assert "accuracy" in test_names
+
+
+def test_grad_flows_everywhere(rng_np):
+    net = Net(zoo.lenet(with_accuracy=False), phase="TRAIN",
+              source_shapes=zoo.lenet_shapes(2))
+    params = net.init(jax.random.PRNGKey(0))
+    batch = _batch(zoo.lenet_shapes(2), rng_np)
+
+    def loss_fn(p):
+        return net.apply(p, batch, rng=jax.random.PRNGKey(0)).loss
+
+    grads = jax.grad(loss_fn)(params)
+    for lname, lg in grads.items():
+        for pname, g in lg.items():
+            assert np.isfinite(np.asarray(g)).all(), (lname, pname)
+            assert np.abs(np.asarray(g)).sum() > 0, (lname, pname)
+
+
+def test_inplace_layers(rng_np):
+    # relu1 writes its bottom in place (top == bottom), the Caffe idiom.
+    net = Net(zoo.cifar10_quick(), phase="TRAIN",
+              source_shapes=zoo.cifar10_shapes(2))
+    params = net.init(jax.random.PRNGKey(0))
+    out = net.apply(params, _batch(zoo.cifar10_shapes(2), rng_np),
+                    rng=jax.random.PRNGKey(1), keep_blobs=True)
+    assert np.asarray(out.blobs["pool1"]).min() >= 0  # post-relu view
+
+
+def test_deploy_net_with_input_decl(rng_np):
+    net_param = load_net_from_string("""
+    name: "deploy"
+    input: "data"
+    input_dim: 2 input_dim: 3 input_dim: 8 input_dim: 8
+    layers { name: "conv" type: CONVOLUTION bottom: "data" top: "conv"
+      convolution_param { num_output: 4 kernel_size: 3
+        weight_filler { type: "xavier" } } }
+    layers { name: "prob" type: SOFTMAX bottom: "conv" top: "prob" }
+    """)
+    net = Net(net_param, phase="TEST")
+    assert net.blob_shapes["prob"] == (2, 4, 6, 6)
+    params = net.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(rng_np.randn(2, 3, 8, 8).astype(np.float32))
+    out = net.apply(params, {"data": x})
+    np.testing.assert_allclose(
+        np.asarray(out.outputs["prob"]).sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_googlenet_builds():
+    net = Net(zoo.googlenet(num_classes=100), phase="TRAIN",
+              source_shapes=zoo.googlenet_shapes(2))
+    assert net.blob_shapes["inception_3a/output"] == (2, 256, 28, 28)
+    assert net.blob_shapes["inception_5b/output"] == (2, 1024, 7, 7)
+    assert net.blob_shapes["pool5/7x7_s1"] == (2, 1024, 1, 1)
+    # three losses in TRAIN phase
+    loss_layers = [l for l in net.layers if l.TYPE == "SOFTMAX_LOSS"]
+    assert len(loss_layers) == 3
+
+
+def test_alexnet_builds():
+    net = Net(zoo.alexnet(), phase="TRAIN",
+              source_shapes=zoo.alexnet_shapes(2))
+    assert net.blob_shapes["pool5"] == (2, 256, 6, 6)
+    assert net.param_count() > 60_000_000  # AlexNet ~61M params
+
+
+def test_weight_export_import_roundtrip(rng_np):
+    net = Net(zoo.lenet(), phase="TRAIN", source_shapes=zoo.lenet_shapes(2))
+    params = net.init(jax.random.PRNGKey(0))
+    exported = net.export_weights(params)
+    params2 = net.init(jax.random.PRNGKey(42))
+    params3 = net.load_weights(params2, exported)
+    for l in exported:
+        for pd, arr in zip(net.param_defs[l], exported[l]):
+            np.testing.assert_array_equal(np.asarray(params3[l][pd.name]), arr)
+
+
+def test_caffemodel_wire_roundtrip(rng_np, tmp_path):
+    from poseidon_tpu.proto.wire import decode_caffemodel, encode_caffemodel
+    net = Net(zoo.lenet(), phase="TRAIN", source_shapes=zoo.lenet_shapes(2))
+    params = net.init(jax.random.PRNGKey(0))
+    blob = encode_caffemodel("LeNet", net.export_weights(params))
+    decoded = decode_caffemodel(blob)
+    assert set(decoded) == set(net.param_defs)
+    np.testing.assert_allclose(
+        decoded["conv1"][0].reshape(20, 1, 5, 5),
+        np.asarray(params["conv1"]["w"]), rtol=1e-6)

@@ -1,0 +1,161 @@
+"""Golden-value numerics tests: XLA ops vs naive-numpy Caffe semantics."""
+
+import numpy as np
+import pytest
+
+import caffe_ref as ref
+from poseidon_tpu.ops import elementwise as E
+from poseidon_tpu.ops import losses as L
+from poseidon_tpu.ops import nn as NN
+
+
+@pytest.mark.parametrize("k,s,p,h", [
+    (2, 2, 0, 8), (3, 2, 0, 7), (3, 2, 1, 8), (5, 3, 2, 13), (3, 1, 1, 6),
+])
+def test_max_pool_matches_caffe(rng_np, k, s, p, h):
+    x = rng_np.randn(2, 3, h, h).astype(np.float32)
+    got = np.asarray(NN.max_pool(x, (k, k), (s, s), (p, p)))
+    want = ref.max_pool(x, k, s, p)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("k,s,p,h", [
+    (2, 2, 0, 8), (3, 2, 0, 7), (3, 2, 1, 8), (5, 3, 2, 13), (3, 1, 1, 6),
+])
+def test_ave_pool_matches_caffe(rng_np, k, s, p, h):
+    x = rng_np.randn(2, 3, h, h).astype(np.float32)
+    got = np.asarray(NN.ave_pool(x, (k, k), (s, s), (p, p)))
+    want = ref.ave_pool(x, k, s, p)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("group", [1, 2])
+def test_conv_matches_caffe(rng_np, group):
+    x = rng_np.randn(2, 4, 9, 9).astype(np.float32)
+    w = rng_np.randn(6, 4 // group, 3, 3).astype(np.float32)
+    b = rng_np.randn(6).astype(np.float32)
+    got = np.asarray(NN.conv2d(x, w, b, (2, 2), (1, 1), group))
+    want = ref.conv2d(x, w, b, 2, 1, group)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_across_channels(rng_np):
+    x = rng_np.randn(2, 8, 5, 5).astype(np.float32)
+    got = np.asarray(NN.lrn_across_channels(x, 5, 1e-4, 0.75))
+    want = ref.lrn_across(x, 5, 1e-4, 0.75)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_lrn_within_channel(rng_np):
+    x = rng_np.randn(2, 3, 7, 7).astype(np.float32)
+    got = np.asarray(NN.lrn_within_channel(x, 3, 5e-5, 0.75))
+    want = ref.lrn_within(x, 3, 5e-5, 0.75)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_softmax_loss_matches_caffe(rng_np):
+    logits = rng_np.randn(4, 10).astype(np.float32)
+    labels = rng_np.randint(0, 10, size=(4,))
+    got = float(L.softmax_loss(logits, labels))
+    want = ref.softmax_loss(logits, labels)
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_softmax_loss_spatial(rng_np):
+    logits = rng_np.randn(2, 5, 3, 3).astype(np.float32)
+    labels = rng_np.randint(0, 5, size=(2, 3, 3))
+    got = float(L.softmax_loss(logits, labels))
+    want = ref.softmax_loss(logits, labels)
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_euclidean_loss(rng_np):
+    a = rng_np.randn(4, 3).astype(np.float32)
+    b = rng_np.randn(4, 3).astype(np.float32)
+    assert float(L.euclidean_loss(a, b)) == pytest.approx(
+        ((a - b) ** 2).sum() / 8.0, rel=1e-6)
+
+
+def test_hinge_loss(rng_np):
+    s = rng_np.randn(3, 5).astype(np.float32)
+    y = np.array([1, 0, 4])
+    m = s.copy()
+    m[np.arange(3), y] *= -1
+    m = np.maximum(0, 1 + m)
+    assert float(L.hinge_loss(s, y, "L1")) == pytest.approx(m.sum() / 3, rel=1e-6)
+    assert float(L.hinge_loss(s, y, "L2")) == pytest.approx(
+        (m * m).sum() / 3, rel=1e-6)
+
+
+def test_accuracy_topk(rng_np):
+    s = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], np.float32)
+    y = np.array([1, 2])
+    assert float(L.accuracy(s, y, 1)) == pytest.approx(0.5)
+    assert float(L.accuracy(s, y, 2)) == pytest.approx(0.5)
+    assert float(L.accuracy(s, y, 3)) == pytest.approx(1.0)
+
+
+def test_sigmoid_ce(rng_np):
+    x = rng_np.randn(3, 4).astype(np.float32)
+    t = rng_np.rand(3, 4).astype(np.float32)
+    want = (np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x)))).sum() / 3
+    assert float(L.sigmoid_cross_entropy_loss(x, t)) == pytest.approx(want, rel=1e-5)
+
+
+def test_contrastive_loss(rng_np):
+    a = rng_np.randn(4, 6).astype(np.float32)
+    b = rng_np.randn(4, 6).astype(np.float32)
+    y = np.array([1, 0, 1, 0], np.float32)
+    d2 = ((a - b) ** 2).sum(1)
+    want = (np.where(y > 0, d2, np.maximum(1.0 - d2, 0))).sum() / 8
+    assert float(L.contrastive_loss(a, b, y, 1.0)) == pytest.approx(want, rel=1e-5)
+
+
+def test_bnll_power_threshold(rng_np):
+    x = rng_np.randn(3, 4).astype(np.float32) * 3
+    np.testing.assert_allclose(
+        np.asarray(E.bnll(x)), np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(E.power(x, 2.0, 0.5, 1.0)), (1.0 + 0.5 * x) ** 2, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(E.threshold(x, 0.5)), (x > 0.5).astype(np.float32))
+
+
+def test_mvn(rng_np):
+    x = rng_np.randn(2, 3, 4, 4).astype(np.float32)
+    got = np.asarray(E.mvn(x, True, False))
+    for i in range(2):
+        for c in range(3):
+            sl = x[i, c]
+            want = (sl - sl.mean()) / (np.sqrt((sl ** 2).mean() - sl.mean() ** 2) + 1e-10)
+            np.testing.assert_allclose(got[i, c], want, rtol=1e-4, atol=1e-5)
+
+
+def test_eltwise_and_slice(rng_np):
+    a = rng_np.randn(2, 4).astype(np.float32)
+    b = rng_np.randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(E.eltwise([a, b], "SUM", [2.0, -1.0])),
+                               2 * a - b, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(E.eltwise([a, b], "MAX", [])),
+                               np.maximum(a, b))
+    parts = E.slice_blob(a, 1, [1, 3], 3)
+    assert [p.shape[1] for p in parts] == [1, 2, 1]
+
+
+def test_im2col_shape(rng_np):
+    x = rng_np.randn(2, 3, 8, 8).astype(np.float32)
+    out = np.asarray(NN.im2col(x, (3, 3), (2, 2), (1, 1)))
+    assert out.shape == (2, 27, 4, 4)
+
+
+def test_dropout_scaling(rng_np):
+    import jax
+    x = np.ones((1000,), np.float32)
+    y = np.asarray(E.dropout(x, 0.4, jax.random.PRNGKey(0), True))
+    kept = y[y > 0]
+    np.testing.assert_allclose(kept, 1.0 / 0.6, rtol=1e-5)
+    assert abs(len(kept) / 1000 - 0.6) < 0.08
+    np.testing.assert_allclose(np.asarray(E.dropout(x, 0.4, None, False)), x)

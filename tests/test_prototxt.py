@@ -1,0 +1,155 @@
+import os
+
+import pytest
+
+from poseidon_tpu.proto import (
+    load_net_from_string, load_solver_from_string, parse,
+)
+from poseidon_tpu.proto.messages import load_net, load_solver
+
+REF = "/root/reference"
+
+LENET_SNIPPET = """
+name: "TestNet"
+layers {
+  name: "conv1"
+  type: CONVOLUTION
+  bottom: "data"
+  top: "conv1"
+  blobs_lr: 1
+  blobs_lr: 2
+  convolution_param {
+    num_output: 20
+    kernel_size: 5
+    stride: 1
+    weight_filler { type: "xavier" }
+    bias_filler { type: "constant" }
+  }
+}
+layers {
+  name: "relu1"
+  type: RELU
+  bottom: "conv1"
+  top: "conv1"
+}
+"""
+
+
+def test_parse_v1_layers():
+    net = load_net_from_string(LENET_SNIPPET)
+    assert net.name == "TestNet"
+    assert len(net.layers) == 2
+    c = net.layers[0]
+    assert c.canonical_type() == "CONVOLUTION"
+    assert c.convolution_param.num_output == 20
+    assert c.convolution_param.kernel_size == 5
+    assert c.convolution_param.weight_filler.type == "xavier"
+    assert c.blobs_lr == [1, 2]
+    assert c.param_spec(0).lr_mult == 1
+    assert c.param_spec(1).lr_mult == 2
+    assert net.layers[1].canonical_type() == "RELU"
+
+
+def test_parse_v2_layer_format():
+    net = load_net_from_string("""
+    layer {
+      name: "fc"
+      type: "InnerProduct"
+      bottom: "x" top: "y"
+      param { lr_mult: 1 decay_mult: 1 }
+      param { lr_mult: 2 decay_mult: 0 }
+      inner_product_param { num_output: 10 }
+    }
+    """)
+    fc = net.layers[0]
+    assert fc.canonical_type() == "INNER_PRODUCT"
+    assert fc.param_spec(1).lr_mult == 2
+    assert fc.param_spec(1).decay_mult == 0
+
+
+def test_parse_solver():
+    sp = load_solver_from_string("""
+    net: "train_val.prototxt"
+    base_lr: 0.01
+    lr_policy: "step"
+    gamma: 0.1
+    stepsize: 100000
+    display: 20
+    max_iter: 450000
+    momentum: 0.9
+    weight_decay: 0.0005
+    solver_mode: GPU
+    solver_type: NESTEROV
+    test_iter: 1000
+    test_interval: 1000
+    random_seed: 7
+    """)
+    assert sp.base_lr == pytest.approx(0.01)
+    assert sp.lr_policy == "step"
+    assert sp.solver_type == "NESTEROV"
+    assert sp.solver_mode == "GPU"
+    assert sp.test_iter == [1000]
+    assert sp.random_seed == 7
+
+
+def test_comments_strings_escapes():
+    node = parse('a: 1 # comment\nb: "hi \\"there\\"" c: -1.5e-3 d: true')
+    assert node.get("a") == 1
+    assert node.get("b") == 'hi "there"'
+    assert node.get("c") == pytest.approx(-0.0015)
+    assert node.get("d") is True
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+@pytest.mark.parametrize("relpath", [
+    "examples/mnist/lenet_train_test.prototxt",
+    "examples/cifar10/cifar10_quick_train_test.prototxt",
+    "models/bvlc_alexnet/train_val.prototxt",
+    "models/bvlc_googlenet/train_test.prototxt",
+    "models/bvlc_reference_caffenet/train_val.prototxt",
+])
+def test_parse_reference_model_zoo(relpath):
+    path = os.path.join(REF, relpath)
+    if not os.path.exists(path):
+        pytest.skip(f"{relpath} not in reference")
+    net = load_net(path)
+    assert net.layers, relpath
+    for lp in net.layers:
+        lp.canonical_type()  # every layer type must resolve
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+@pytest.mark.parametrize("relpath", [
+    "examples/mnist/lenet_solver.prototxt",
+    "examples/cifar10/cifar10_quick_solver.prototxt",
+    "models/bvlc_alexnet/solver.prototxt",
+    "models/bvlc_googlenet/quick_solver.prototxt",
+])
+def test_parse_reference_solvers(relpath):
+    path = os.path.join(REF, relpath)
+    if not os.path.exists(path):
+        pytest.skip(f"{relpath} not in reference")
+    sp = load_solver(path)
+    assert sp.base_lr > 0
+
+
+def test_to_prototxt_roundtrip():
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.proto.messages import net_to_prototxt
+    from poseidon_tpu.core.net import Net
+    for build_fn, shapes_fn in [(zoo.lenet, zoo.lenet_shapes),
+                                (zoo.googlenet, zoo.googlenet_shapes)]:
+        net_param = build_fn()
+        text = net_to_prototxt(net_param)
+        reparsed = load_net_from_string(text)
+        assert [l.name for l in reparsed.layers] == \
+            [l.name for l in net_param.layers]
+        # the round-tripped net must build to identical blob shapes
+        a = Net(net_param, "TRAIN", shapes_fn(2))
+        b = Net(reparsed, "TRAIN", shapes_fn(2))
+        assert a.blob_shapes == b.blob_shapes
+        # enum identifiers must be unquoted (Caffe's parser requires it);
+        # default-valued fields (e.g. pool: MAX) are correctly omitted
+        assert 'type: CONVOLUTION' in text
+        assert 'type: "CONVOLUTION"' not in text
+    assert 'pool: AVE' in text  # googlenet's non-default pooling survives

@@ -1,0 +1,111 @@
+"""Solver update math vs hand-computed Caffe semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu.proto.messages import SolverParameter
+from poseidon_tpu.solvers.updates import (
+    SolverState, init_state, learning_rate, make_update_fn)
+
+
+def _mults():
+    return {"l": {"w": (1.0, 1.0)}}
+
+
+def _pack(x):
+    return {"l": {"w": jnp.asarray(x, jnp.float32)}}
+
+
+def test_lr_policies():
+    sp = SolverParameter(base_lr=0.1, lr_policy="step", gamma=0.5, stepsize=10)
+    assert float(learning_rate(sp, jnp.asarray(0))) == pytest.approx(0.1)
+    assert float(learning_rate(sp, jnp.asarray(9))) == pytest.approx(0.1)
+    assert float(learning_rate(sp, jnp.asarray(10))) == pytest.approx(0.05)
+    assert float(learning_rate(sp, jnp.asarray(25))) == pytest.approx(0.025)
+
+    sp = SolverParameter(base_lr=0.1, lr_policy="inv", gamma=1e-4, power=0.75)
+    assert float(learning_rate(sp, jnp.asarray(100))) == pytest.approx(
+        0.1 * (1 + 1e-4 * 100) ** -0.75, rel=1e-5)
+
+    sp = SolverParameter(base_lr=0.1, lr_policy="poly", power=2.0, max_iter=100)
+    assert float(learning_rate(sp, jnp.asarray(50))) == pytest.approx(
+        0.1 * 0.25, rel=1e-5)
+
+    sp = SolverParameter(base_lr=0.1, lr_policy="exp", gamma=0.99)
+    assert float(learning_rate(sp, jnp.asarray(10))) == pytest.approx(
+        0.1 * 0.99 ** 10, rel=1e-5)
+
+    sp = SolverParameter(base_lr=0.1, lr_policy="multistep", gamma=0.1,
+                         stepvalue=[5, 8])
+    assert float(learning_rate(sp, jnp.asarray(6))) == pytest.approx(0.01)
+    assert float(learning_rate(sp, jnp.asarray(9))) == pytest.approx(0.001)
+
+
+def test_sgd_momentum_weight_decay():
+    sp = SolverParameter(base_lr=0.1, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.01, solver_type="SGD")
+    update = make_update_fn(sp, _mults())
+    w = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.25], np.float32)
+    params, state = _pack(w), init_state(_pack(w))
+    params, state = update(params, _pack(g), state)
+    # h = 0.9*0 + 0.1*(g + 0.01*w); w -= h
+    h = 0.1 * (g + 0.01 * w)
+    np.testing.assert_allclose(np.asarray(params["l"]["w"]), w - h, rtol=1e-6)
+    # second step: momentum kicks in
+    params, state = update(params, _pack(g), state)
+    w1 = w - h
+    h2 = 0.9 * h + 0.1 * (g + 0.01 * w1)
+    np.testing.assert_allclose(np.asarray(params["l"]["w"]), w1 - h2, rtol=1e-6)
+
+
+def test_sgd_l1_regularization():
+    sp = SolverParameter(base_lr=0.1, lr_policy="fixed", momentum=0.0,
+                         weight_decay=0.01, regularization_type="L1")
+    update = make_update_fn(sp, _mults())
+    w = np.array([1.0, -2.0, 0.0], np.float32)
+    g = np.zeros(3, np.float32)
+    params, state = update(_pack(w), _pack(g), init_state(_pack(w)))
+    expect = w - 0.1 * 0.01 * np.sign(w)
+    np.testing.assert_allclose(np.asarray(params["l"]["w"]), expect, rtol=1e-6)
+
+
+def test_nesterov():
+    sp = SolverParameter(base_lr=0.1, lr_policy="fixed", momentum=0.9,
+                         solver_type="NESTEROV")
+    update = make_update_fn(sp, _mults())
+    w = np.array([1.0], np.float32)
+    g = np.array([1.0], np.float32)
+    params, state = update(_pack(w), _pack(g), init_state(_pack(w)))
+    # h' = 0.1; step = 1.9*0.1 - 0.9*0 = 0.19
+    np.testing.assert_allclose(np.asarray(params["l"]["w"]), [1.0 - 0.19],
+                               rtol=1e-6)
+
+
+def test_adagrad():
+    sp = SolverParameter(base_lr=0.1, lr_policy="fixed", solver_type="ADAGRAD",
+                         delta=1e-8)
+    update = make_update_fn(sp, _mults())
+    w = np.array([1.0], np.float32)
+    g = np.array([2.0], np.float32)
+    params, state = update(_pack(w), _pack(g), init_state(_pack(w)))
+    # h = 4; step = 0.1 * 2 / (2 + 1e-8) = 0.1
+    np.testing.assert_allclose(np.asarray(params["l"]["w"]), [0.9], rtol=1e-5)
+    params, state = update(params, _pack(g), state)
+    # h = 8; step = 0.1*2/sqrt(8)
+    np.testing.assert_allclose(np.asarray(params["l"]["w"]),
+                               [0.9 - 0.2 / np.sqrt(8)], rtol=1e-5)
+
+
+def test_lr_mult_decay_mult():
+    sp = SolverParameter(base_lr=0.1, lr_policy="fixed", weight_decay=0.01)
+    mults = {"l": {"w": (2.0, 0.0)}}
+    update = make_update_fn(sp, mults)
+    w = np.array([1.0], np.float32)
+    g = np.array([1.0], np.float32)
+    params, _ = update(_pack(w), _pack(g), init_state(_pack(w)))
+    # lr doubled, decay zeroed
+    np.testing.assert_allclose(np.asarray(params["l"]["w"]), [1.0 - 0.2],
+                               rtol=1e-6)
