@@ -1,1 +1,7 @@
-
+from .pipeline import BatchPipeline, build_source, layer_batch_size  # noqa: F401
+from .sources import (  # noqa: F401
+    HDF5Source, ImageListSource, LMDBSource, MemorySource, Source,
+    SyntheticSource,
+)
+from .transformer import DataTransformer  # noqa: F401
+from .workload import Shard, contiguous_range, shard_indices  # noqa: F401

@@ -1,0 +1,204 @@
+"""Batch pipeline: source -> transform -> background prefetch -> device.
+
+The counterpart of ``BasePrefetchingDataLayer`` + ``InternalThread``
+(``src/caffe/layers/base_data_layer.cpp:73-103``): a daemon thread keeps a
+bounded queue of ready batches (transform applied, numpy, pinned layout) while
+the TPU trains on the current one; ``__next__`` hands back host arrays the
+trainer device_puts with the batch sharding.
+
+``build_source`` maps a data-layer ``LayerParameter`` to a Source with the
+reference's backend selection (data_layer.cpp, layer catalog §2.1) and the
+``shared_file_system`` `_k` suffix sharding.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from ..proto.messages import LayerParameter, TransformationParameter
+from .sources import (HDF5Source, ImageListSource, LMDBSource, LevelDBSource,
+                      MemorySource, Source)
+from .transformer import DataTransformer
+from .workload import Shard, shard_indices, sharded_source_path
+
+
+def _effective_transform(lp: LayerParameter) -> TransformationParameter:
+    """Merge the deprecated in-layer fields (scale/mean_file/crop/mirror on
+    data_param etc.) into a TransformationParameter, preferring the modern
+    transform_param when set (upgrade_proto.cpp behavior)."""
+    tp = lp.transform_param
+    legacy = None
+    t = lp.canonical_type()
+    if t == "DATA":
+        legacy = lp.data_param
+    elif t == "IMAGE_DATA":
+        legacy = lp.image_data_param
+    elif t == "WINDOW_DATA":
+        legacy = lp.window_data_param
+    if legacy is not None:
+        merged = TransformationParameter(
+            scale=tp.scale if tp.scale != 1.0 else legacy.scale,
+            mirror=tp.mirror or legacy.mirror,
+            crop_size=tp.crop_size or legacy.crop_size,
+            mean_file=tp.mean_file or legacy.mean_file,
+            mean_value=list(tp.mean_value),
+        )
+        return merged
+    return tp
+
+
+def build_source(lp: LayerParameter, shard: Shard,
+                 memory_data: Optional[Dict[str, np.ndarray]] = None) -> Source:
+    t = lp.canonical_type()
+    if t == "DATA":
+        dp = lp.data_param
+        path = sharded_source_path(dp.source, shard.index,
+                                   dp.shared_file_system)
+        if dp.backend == "LMDB":
+            return LMDBSource(path)
+        # Try LMDB layout anyway (a converted DB may sit at the same path)
+        try:
+            return LMDBSource(path)
+        except Exception:
+            return LevelDBSource(path)
+    if t == "IMAGE_DATA":
+        ip = lp.image_data_param
+        path = sharded_source_path(ip.source, shard.index,
+                                   ip.shared_file_system)
+        return ImageListSource(path, ip.root_folder, ip.new_height,
+                               ip.new_width, ip.shuffle)
+    if t == "HDF5_DATA":
+        return HDF5Source(lp.hdf5_data_param.source)
+    if t == "MEMORY_DATA":
+        if memory_data is None:
+            raise ValueError(
+                f"layer {lp.name!r}: MEMORY_DATA requires arrays passed via "
+                f"memory_data={{'data': ..., 'label': ...}}")
+        return MemorySource(memory_data["data"], memory_data["label"])
+    raise ValueError(f"layer {lp.name!r}: {t} is not a batch source")
+
+
+def layer_batch_size(lp: LayerParameter) -> int:
+    t = lp.canonical_type()
+    return {
+        "DATA": lp.data_param.batch_size,
+        "IMAGE_DATA": lp.image_data_param.batch_size,
+        "HDF5_DATA": lp.hdf5_data_param.batch_size,
+        "MEMORY_DATA": lp.memory_data_param.batch_size,
+        "WINDOW_DATA": lp.window_data_param.batch_size,
+    }[t]
+
+
+class BatchPipeline:
+    """Iterates {top_name: np.ndarray} batches forever (epoch wraparound),
+    prefetching `prefetch` batches ahead on a daemon thread."""
+
+    def __init__(
+        self,
+        lp: LayerParameter,
+        phase: str,
+        batch_size: int,
+        shard: Shard = Shard(0, 1),
+        prefetch: int = 3,
+        seed: int = 0,
+        shuffle: Optional[bool] = None,
+        memory_data: Optional[Dict[str, np.ndarray]] = None,
+    ):
+        self.lp = lp
+        self.source = build_source(lp, shard, memory_data)
+        self.transformer = DataTransformer(_effective_transform(lp), phase,
+                                           seed=seed)
+        self.batch_size = batch_size
+        self.shard = shard
+        self.seed = seed
+        self.shuffle = (phase == "TRAIN") if shuffle is None else shuffle
+        self.tops = list(lp.top)
+        c, h, w = self.source.record_shape
+        self.data_shape = (batch_size,) + self.transformer.output_shape(c, h, w)
+        self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._stop = threading.Event()
+        self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    def _index_stream(self) -> Iterator[int]:
+        epoch = 0
+        while True:
+            idx = shard_indices(len(self.source), self.shard, epoch,
+                                self.shuffle, self.seed)
+            if len(idx) == 0:
+                raise RuntimeError("shard received zero records")
+            yield from idx
+            epoch += 1
+
+    def _worker(self):
+        stream = self._index_stream()
+        try:
+            while not self._stop.is_set():
+                raw = np.empty((self.batch_size,) + self.source.record_shape,
+                               np.float32)
+                labels = np.empty((self.batch_size,), np.int32)
+                for i in range(self.batch_size):
+                    arr, label = self.source.read(next(stream))
+                    raw[i] = arr
+                    labels[i] = label
+                batch = {self.tops[0]: self.transformer(raw)}
+                if len(self.tops) > 1:
+                    batch[self.tops[1]] = labels
+                self._queue.put(batch)
+        except Exception as e:  # surface worker death to the consumer
+            self._queue.put(e)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        item = self._queue.get()
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+
+def build_phase_pipelines(net_param, phase: str, batch_multiplier: int,
+                          shard: Shard = Shard(0, 1),
+                          memory_data: Optional[Dict[str, np.ndarray]] = None,
+                          seed: int = 0):
+    """Build a BatchPipeline per data layer of `net_param` at `phase`.
+
+    Returns (pipelines, source_shapes) where source_shapes carry the
+    PER-DEVICE batch (the prototxt batch_size) and each pipeline yields
+    batch_size * batch_multiplier rows (the caller's per-host batch).
+    Shared by Engine, `test`, and `extract_features` so batch semantics stay
+    in one place.
+    """
+    from ..core.layers import DATA_SOURCE_TYPES
+    from ..core.net import filter_net
+    from ..proto.messages import NetState
+
+    pipes = []
+    shapes: Dict[str, tuple] = {}
+    for lp in filter_net(net_param, NetState(phase=phase)):
+        if lp.canonical_type() not in DATA_SOURCE_TYPES:
+            continue
+        per_dev = layer_batch_size(lp)
+        if per_dev <= 0:
+            raise ValueError(f"layer {lp.name!r}: batch_size must be set")
+        pipe = BatchPipeline(lp, phase, per_dev * batch_multiplier,
+                             shard=shard, memory_data=memory_data, seed=seed)
+        pipes.append(pipe)
+        shapes[lp.top[0]] = (per_dev,) + tuple(pipe.data_shape[1:])
+        if len(lp.top) > 1:
+            shapes[lp.top[1]] = (per_dev,)
+    return pipes, shapes

@@ -1,1 +1,5 @@
-
+from .engine import Engine, resolve_nets  # noqa: F401
+from .metrics import MetricsTable, StatsRegistry, log  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    latest_snapshot, load_caffemodel, restore, snapshot,
+)

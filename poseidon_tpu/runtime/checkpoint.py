@@ -1,0 +1,120 @@
+"""Snapshots: solver-state checkpoints + .caffemodel weight exchange.
+
+The reference writes two artifacts (solver.cpp:632-696): the model as a
+binary NetParameter (``.caffemodel``, written by rank 0) and per-worker
+``.solverstate`` files with the iteration and momentum history. Here:
+
+- ``snapshot()`` writes ``<prefix>_iter_<N>.caffemodel`` (wire-compatible with
+  Caffe) and ``<prefix>_iter_<N>.solverstate.npz`` (params + history + iter +
+  comm residuals), sharding-agnostic since params are replicated.
+- ``restore()`` rebuilds (params, TrainState) from the .npz;
+  ``load_caffemodel()`` imports weights alone (CopyTrainedLayersFrom).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.net import Net
+from ..parallel.trainer import TrainState
+from ..proto.wire import decode_caffemodel, encode_caffemodel
+from ..solvers.updates import SolverState
+
+
+# Layer names may contain '/' (GoogLeNet's "inception_3a/1x1"), so tree keys
+# are joined with the ASCII unit separator, which cannot appear in prototxt
+# identifiers.
+_SEP = "\x1f"
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + _SEP))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]):
+    tree: Dict = {}
+    for key, v in flat.items():
+        parts = key.split(_SEP)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(v)
+    return tree
+
+
+def snapshot(prefix: str, net: Net, params, state: TrainState) -> Tuple[str, str]:
+    it = int(state.solver.it)
+    os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+    model_path = f"{prefix}_iter_{it}.caffemodel"
+    state_path = f"{prefix}_iter_{it}.solverstate.npz"
+
+    with open(model_path, "wb") as f:
+        f.write(encode_caffemodel(net.name or "net", net.export_weights(params)))
+
+    arrays = {}
+    arrays.update({f"params/{k}": v for k, v in _flatten(params).items()})
+    arrays.update({f"history/{k}": v
+                   for k, v in _flatten(state.solver.history).items()})
+    arrays.update({f"comm_error/{k}": v
+                   for k, v in _flatten(state.comm_error).items()})
+    arrays["iter"] = np.asarray(it)
+    np.savez(state_path, **arrays)
+    return model_path, state_path
+
+
+def restore(state_path: str) -> Tuple[Dict, TrainState]:
+    z = np.load(state_path)
+    params_flat, hist_flat, err_flat = {}, {}, {}
+    it = 0
+    for key in z.files:
+        if key == "iter":
+            it = int(z[key])
+        elif key.startswith("params/"):
+            params_flat[key[len("params/"):]] = z[key]
+        elif key.startswith("history/"):
+            hist_flat[key[len("history/"):]] = z[key]
+        elif key.startswith("comm_error/"):
+            err_flat[key[len("comm_error/"):]] = z[key]
+    params = _unflatten(params_flat)
+    state = TrainState(
+        solver=SolverState(it=jnp.asarray(it, jnp.int32),
+                           history=_unflatten(hist_flat)),
+        comm_error=_unflatten(err_flat))
+    return params, state
+
+
+def load_caffemodel(path: str, net: Net, params):
+    with open(path, "rb") as f:
+        weights = decode_caffemodel(f.read())
+    return net.load_weights(params, weights)
+
+
+def latest_snapshot(prefix: str) -> Optional[str]:
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    best, best_it = None, -1
+    if not os.path.isdir(d):
+        return None
+    for name in os.listdir(d):
+        if name.startswith(base + "_iter_") and \
+                name.endswith(".solverstate.npz"):
+            try:
+                it = int(name[len(base + "_iter_"):-len(".solverstate.npz")])
+            except ValueError:
+                continue
+            if it > best_it:
+                best, best_it = os.path.join(d, name), it
+    return best

@@ -1,0 +1,274 @@
+"""CLI: the caffe_main-equivalent command registry — ALL brew commands live.
+
+The reference's ``caffe_main <command>`` exposes train and device_query, with
+test/time compiled out behind #if 0 (tools/caffe_main.cpp:49-350). Here every
+command works: train, test, time, device_query, plus the dataset tools and the
+feature extractor.
+
+    python -m poseidon_tpu train --solver=examples/mnist/lenet_solver.prototxt
+    python -m poseidon_tpu test --model=net.prototxt --weights=x.caffemodel --iterations=50
+    python -m poseidon_tpu time --model=net.prototxt --iterations=50
+    python -m poseidon_tpu device_query
+    python -m poseidon_tpu convert_imageset|compute_image_mean|partition_data|extract_features ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time as _time
+from typing import List, Optional
+
+import numpy as np
+
+
+def cmd_device_query(args) -> int:
+    import jax
+    for d in jax.devices():
+        print(f"device {d.id}: platform={d.platform} kind={d.device_kind} "
+              f"process={d.process_index}")
+    print(f"process_count={jax.process_count()} "
+          f"local_devices={jax.local_device_count()}")
+    return 0
+
+
+def _engine_from_args(args, phase_nets=True):
+    from ..parallel.strategies import CommConfig
+    from ..proto.messages import load_solver
+    from .engine import Engine
+
+    sp = load_solver(args.solver)
+    comm = CommConfig(default_strategy=args.strategy,
+                      reduce=args.grad_reduce)
+    if args.sfb_auto:
+        comm = CommConfig(reduce=args.grad_reduce)
+    eng = Engine(sp, comm=comm, output_dir=args.output_dir)
+    if args.sfb_auto:
+        from ..parallel.strategies import auto_strategies
+        comm.layer_strategies.update(auto_strategies(eng.train_net))
+    return eng
+
+
+def cmd_train(args) -> int:
+    eng = _engine_from_args(args)
+    if args.snapshot:
+        eng.restore_from(args.snapshot)
+    elif args.weights:
+        eng.restore_from(args.weights)
+    try:
+        eng.train()
+    finally:
+        eng.close()
+    return 0
+
+
+def cmd_test(args) -> int:
+    import jax
+    from ..core.net import Net
+    from ..data.pipeline import build_phase_pipelines
+    from ..parallel import build_eval_step, make_mesh
+    from ..proto.messages import load_net
+    from .checkpoint import load_caffemodel
+
+    net_param = load_net(args.model)
+    mesh = make_mesh()
+    pipes, shapes = build_phase_pipelines(net_param, "TEST",
+                                          jax.device_count())
+    net = Net(net_param, "TEST", source_shapes=shapes)
+    params = net.init(jax.random.PRNGKey(0))
+    if args.weights:
+        params = load_caffemodel(args.weights, net, params)
+    ev = build_eval_step(net, mesh)
+    acc = {}
+    for _ in range(args.iterations):
+        batch = {}
+        for pipe in pipes:
+            for k, v in next(pipe).items():
+                batch[k] = jax.device_put(v)
+        for k, v in ev(params, batch).items():
+            acc[k] = acc.get(k, 0.0) + float(v)
+    for k in sorted(acc):
+        print(f"{k}: {acc[k] / args.iterations:.4f}")
+    for p in pipes:
+        p.close()
+    return 0
+
+
+def cmd_time(args) -> int:
+    """Per-layer forward timing + whole-graph forward/backward timing
+    (the reference's `caffe time`, tools/caffe_main.cpp:256-328)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.net import Net
+    from ..proto.messages import load_net
+
+    net_param = load_net(args.model)
+    shapes = {}
+    if net_param.input:
+        net = Net(net_param, "TRAIN")
+    else:
+        # synthesize source shapes for data layers
+        from ..core.net import filter_net
+        from ..proto.messages import NetState
+        from ..core.layers import DATA_SOURCE_TYPES
+        for lp in filter_net(net_param, NetState(phase="TRAIN")):
+            if lp.canonical_type() in DATA_SOURCE_TYPES:
+                from ..data.pipeline import layer_batch_size
+                b = layer_batch_size(lp) or args.batch_size
+                c = lp.transform_param.crop_size or 224
+                shapes[lp.top[0]] = (b, 3, c, c)
+                if len(lp.top) > 1:
+                    shapes[lp.top[1]] = (b,)
+        net = Net(net_param, "TRAIN", source_shapes=shapes)
+    # the benchmark batch is whatever the model actually declares
+    batch = net.blob_shapes[net.input_names[0]][0]
+
+    rng = jax.random.PRNGKey(0)
+    params = net.init(rng)
+    inputs = {name: (jnp.zeros(net.blob_shapes[name], jnp.float32)
+                     if len(net.blob_shapes[name]) > 1 else
+                     jnp.zeros(net.blob_shapes[name], jnp.int32))
+              for name in net.input_names}
+
+    fwd = jax.jit(lambda p, x: net.apply(p, x, train=True,
+                                         rng=jax.random.PRNGKey(1)).loss)
+    grad = jax.jit(jax.grad(lambda p, x: net.apply(
+        p, x, train=True, rng=jax.random.PRNGKey(1)).loss))
+
+    jax.block_until_ready(fwd(params, inputs))  # compile
+    t0 = _time.perf_counter()
+    for _ in range(args.iterations):
+        out = fwd(params, inputs)
+    jax.block_until_ready(out)
+    fwd_ms = (_time.perf_counter() - t0) / args.iterations * 1e3
+
+    jax.block_until_ready(jax.tree_util.tree_leaves(grad(params, inputs))[0])
+    t0 = _time.perf_counter()
+    for _ in range(args.iterations):
+        g = grad(params, inputs)
+    jax.block_until_ready(jax.tree_util.tree_leaves(g)[0])
+    fb_ms = (_time.perf_counter() - t0) / args.iterations * 1e3
+
+    print(f"Average Forward pass: {fwd_ms:.3f} ms")
+    print(f"Average Forward-Backward: {fb_ms:.3f} ms")
+    print(f"Throughput: {batch / (fb_ms / 1e3):.1f} images/s "
+          f"(batch {batch})")
+    return 0
+
+
+def cmd_convert_imageset(args) -> int:
+    from .tools import convert_imageset
+    convert_imageset(args.listfile, args.out_db, root_folder=args.root_folder,
+                     resize_height=args.resize_height,
+                     resize_width=args.resize_width, shuffle=args.shuffle,
+                     gray=args.gray)
+    return 0
+
+
+def cmd_compute_image_mean(args) -> int:
+    from .tools import compute_image_mean
+    compute_image_mean(args.db, args.out_file)
+    return 0
+
+
+def cmd_partition_data(args) -> int:
+    from .tools import partition_data
+    partition_data(args.db, args.num_shards)
+    return 0
+
+
+def cmd_extract_features(args) -> int:
+    import jax
+    from ..core.net import Net
+    from ..data.pipeline import build_phase_pipelines
+    from ..proto.messages import load_net
+    from .checkpoint import load_caffemodel
+    from .tools import extract_features
+
+    net_param = load_net(args.model)
+    pipes, shapes = build_phase_pipelines(net_param, "TEST", 1)
+    net = Net(net_param, "TEST", source_shapes=shapes)
+    params = net.init(jax.random.PRNGKey(0))
+    if args.weights:
+        params = load_caffemodel(args.weights, net, params)
+    extract_features(net, params, args.blobs.split(","), pipes[0],
+                     args.num_batches, args.out_prefix)
+    for p in pipes:
+        p.close()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="poseidon_tpu",
+                                description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("train", help="train a model from a solver prototxt")
+    t.add_argument("--solver", required=True)
+    t.add_argument("--snapshot", default="",
+                   help="resume from a .solverstate.npz")
+    t.add_argument("--weights", default="",
+                   help="finetune from a .caffemodel")
+    t.add_argument("--output_dir", default=".")
+    t.add_argument("--strategy", default="dense",
+                   choices=["dense", "sfb", "topk"],
+                   help="default gradient sync strategy")
+    t.add_argument("--sfb-auto", action="store_true",
+                   help="pick SFB per FC layer by cost model (SACP)")
+    t.add_argument("--grad-reduce", default="mean", choices=["mean", "sum"])
+    t.set_defaults(fn=cmd_train)
+
+    te = sub.add_parser("test", help="score a model")
+    te.add_argument("--model", required=True)
+    te.add_argument("--weights", default="")
+    te.add_argument("--iterations", type=int, default=50)
+    te.set_defaults(fn=cmd_test)
+
+    ti = sub.add_parser("time", help="benchmark model fwd/bwd")
+    ti.add_argument("--model", required=True)
+    ti.add_argument("--iterations", type=int, default=50)
+    ti.add_argument("--batch_size", type=int, default=64)
+    ti.set_defaults(fn=cmd_time)
+
+    dq = sub.add_parser("device_query", help="show accelerator info")
+    dq.set_defaults(fn=cmd_device_query)
+
+    ci = sub.add_parser("convert_imageset", help="image list -> LMDB")
+    ci.add_argument("listfile")
+    ci.add_argument("out_db")
+    ci.add_argument("--root_folder", default="")
+    ci.add_argument("--resize_height", type=int, default=0)
+    ci.add_argument("--resize_width", type=int, default=0)
+    ci.add_argument("--shuffle", action="store_true")
+    ci.add_argument("--gray", action="store_true")
+    ci.set_defaults(fn=cmd_convert_imageset)
+
+    cm = sub.add_parser("compute_image_mean", help="LMDB -> mean binaryproto")
+    cm.add_argument("db")
+    cm.add_argument("out_file")
+    cm.set_defaults(fn=cmd_compute_image_mean)
+
+    pd = sub.add_parser("partition_data", help="split LMDB into k shards")
+    pd.add_argument("db")
+    pd.add_argument("num_shards", type=int)
+    pd.set_defaults(fn=cmd_partition_data)
+
+    ef = sub.add_parser("extract_features",
+                        help="dump named blobs to LMDBs")
+    ef.add_argument("--model", required=True)
+    ef.add_argument("--weights", default="")
+    ef.add_argument("--blobs", required=True,
+                    help="comma-separated blob names")
+    ef.add_argument("--num_batches", type=int, default=10)
+    ef.add_argument("--out_prefix", required=True)
+    ef.set_defaults(fn=cmd_extract_features)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""Engine: solver-driven training orchestration (CaffeEngine + Solver::Solve).
+
+Mirrors the reference's control flow (caffe_engine.cpp:55-293,
+solver.cpp:246-402) on top of the compiled SPMD step:
+
+- resolve train/test nets from a SolverParameter (file or inline, shared-net
+  phase filtering like Net::FilterNet)
+- data pipelines per data layer, sharded per host, prefetching in background
+- the hot loop: one pjit-compiled step per iteration (forward + backward +
+  per-layer gradient collectives + update), with display / test / snapshot
+  cadence from the solver prototxt
+- metrics aggregated across the mesh inside the step (the net-output-PS-table
+  analog) and flushed to CSV; stats YAML per run.
+
+Batch-size semantics: the prototxt batch_size is PER-DEVICE (the reference's
+per-worker meaning); the global batch is batch_size * num_devices. With the
+default "mean" gradient reduction this behaves like single-worker Caffe at the
+global batch size.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.net import Net
+from ..data.pipeline import (BatchPipeline, build_phase_pipelines,
+                             layer_batch_size)
+from ..data.workload import Shard
+from ..core.layers import DATA_SOURCE_TYPES
+from ..parallel import (CommConfig, build_eval_step, build_train_step,
+                        init_train_state, make_mesh)
+from ..proto.messages import (NetParameter, SolverParameter, load_net,
+                              load_solver)
+from ..solvers.updates import learning_rate
+from .checkpoint import latest_snapshot, load_caffemodel, restore, snapshot
+from .metrics import MetricsTable, StatsRegistry, log
+
+
+def resolve_nets(sp: SolverParameter):
+    """Train NetParameter + list of test NetParameters, per the reference's
+    precedence: train_net_param, train_net, net_param, net (solver.cpp)."""
+    train: Optional[NetParameter] = None
+    tests: List[NetParameter] = []
+    if sp.train_net_param is not None:
+        train = sp.train_net_param
+    elif sp.train_net:
+        train = load_net(sp.train_net)
+    elif sp.net_param is not None:
+        train = sp.net_param
+    elif sp.net:
+        train = load_net(sp.net)
+    else:
+        raise ValueError("solver specifies no train net")
+
+    tests.extend(sp.test_net_param)
+    for path in sp.test_net:
+        tests.append(load_net(path))
+    if not tests and sp.test_iter:
+        # shared-net pattern: same NetParameter filtered by TEST phase
+        tests.append(train)
+    return train, tests
+
+
+class Engine:
+    def __init__(
+        self,
+        sp: SolverParameter,
+        comm: Optional[CommConfig] = None,
+        mesh=None,
+        memory_data: Optional[Dict[str, np.ndarray]] = None,
+        output_dir: str = ".",
+    ):
+        self.sp = sp
+        self.mesh = mesh or make_mesh()
+        self.n_dev = int(np.prod(list(self.mesh.shape.values())))
+        self.comm = comm or CommConfig()
+        self.output_dir = output_dir
+        self.stats = StatsRegistry()
+        self.rank = jax.process_index()
+        self.memory_data = memory_data
+
+        train_param, test_params = resolve_nets(sp)
+
+        # --- data pipelines for the train net ---------------------------- #
+        self.train_pipelines, train_shapes = self._build_pipelines(
+            train_param, "TRAIN")
+        self.train_net = Net(train_param, "TRAIN", source_shapes=train_shapes)
+
+        self.test_nets: List[Net] = []
+        self.test_pipelines: List[List[BatchPipeline]] = []
+        for i, tp in enumerate(test_params):
+            pipes, shapes = self._build_pipelines(tp, "TEST")
+            self.test_nets.append(Net(tp, "TEST", source_shapes=shapes))
+            self.test_pipelines.append(pipes)
+
+        # --- compiled steps ---------------------------------------------- #
+        self.train_step = build_train_step(self.train_net, sp, self.mesh,
+                                           self.comm)
+        self.eval_steps = [build_eval_step(n, self.mesh) for n in self.test_nets]
+
+        # --- state -------------------------------------------------------- #
+        seed = sp.random_seed if sp.random_seed >= 0 else 1
+        self.rng = jax.random.PRNGKey(seed)
+        self.params = self.train_net.init(jax.random.fold_in(self.rng, 0))
+        self.state = init_train_state(self.params, self.comm, self.n_dev)
+        self.metrics = MetricsTable("train")
+        self.test_metrics = [MetricsTable(f"test_{i}")
+                             for i in range(len(self.test_nets))]
+
+    # ---------------------------------------------------------------- #
+    def _build_pipelines(self, net_param: NetParameter, phase: str):
+        # Each host produces only its addressable devices' rows; the pipeline
+        # shards the record space across hosts (shared_file_system-style).
+        return build_phase_pipelines(
+            net_param, phase, batch_multiplier=jax.local_device_count(),
+            shard=Shard(self.rank, jax.process_count()),
+            memory_data=self.memory_data)
+
+    def _next_batch(self, pipes: List[BatchPipeline]):
+        batch: Dict[str, jax.Array] = {}
+        sharding = self.train_step.batch_sharding
+        multihost = jax.process_count() > 1
+        for pipe in pipes:
+            host = next(pipe)
+            for k, v in host.items():
+                if multihost:
+                    batch[k] = jax.make_array_from_process_local_data(
+                        sharding, v)
+                else:
+                    batch[k] = jax.device_put(v, sharding)
+        return batch
+
+    # ---------------------------------------------------------------- #
+    def restore_from(self, path: str):
+        if path.endswith(".caffemodel"):
+            self.params = load_caffemodel(path, self.train_net, self.params)
+            log(f"Loaded weights from {path}", rank=self.rank)
+        else:
+            self.params, self.state = restore(path)
+            log(f"Restored solver state from {path} "
+                f"(iter {int(self.state.solver.it)})", rank=self.rank)
+
+    def snapshot_now(self) -> Optional[str]:
+        if not self.sp.snapshot_prefix:
+            return None
+        prefix = os.path.join(self.output_dir, self.sp.snapshot_prefix)
+        model, statef = snapshot(prefix, self.train_net, self.params,
+                                 self.state)
+        log(f"Snapshotting to {model} / {statef}", rank=self.rank)
+        return statef
+
+    # ---------------------------------------------------------------- #
+    def test(self, test_id: int = 0) -> Dict[str, float]:
+        """Average metrics over test_iter batches (Solver::Test)."""
+        net = self.test_nets[test_id]
+        ev = self.eval_steps[test_id]
+        iters = self.sp.test_iter[test_id] if test_id < len(self.sp.test_iter) \
+            else 50
+        acc: Dict[str, float] = {}
+        for _ in range(iters):
+            batch = self._next_batch(self.test_pipelines[test_id])
+            m = ev(self.params, batch)
+            for k, v in m.items():
+                acc[k] = acc.get(k, 0.0) + float(v)
+        out = {k: v / iters for k, v in acc.items()}
+        msg = ", ".join(f"{k} = {v:.4f}" for k, v in sorted(out.items()))
+        log(f"    Test net #{test_id}: {msg}", rank=self.rank)
+        self.test_metrics[test_id].accumulate(out)
+        return out
+
+    def train(self, max_iter: Optional[int] = None) -> Dict[str, float]:
+        sp = self.sp
+        max_iter = max_iter or sp.max_iter
+        it = int(self.state.solver.it)
+        t_start = time.time()
+        last: Dict[str, float] = {}
+
+        if sp.test_interval and sp.test_initialization and self.test_nets:
+            for i in range(len(self.test_nets)):
+                self.test(i)
+                self.test_metrics[i].flush_row(it)
+
+        while it < max_iter:
+            if sp.snapshot and it > 0 and it % sp.snapshot == 0:
+                self.snapshot_now()
+            batch = self._next_batch(self.train_pipelines)
+            t0 = time.time()
+            self.params, self.state, m = self.train_step.step(
+                self.params, self.state, batch, jax.random.fold_in(self.rng, it))
+            it += 1
+            last = {k: float(v) for k, v in m.items()}
+            self.metrics.accumulate(last)
+            self.stats.add("train_iters")
+            self.stats.add_time("train_step", time.time() - t0)
+
+            if sp.display and it % sp.display == 0:
+                row = self.metrics.flush_row(it)
+                lr = float(learning_rate(sp, jnp.asarray(it - 1)))
+                extras = ", ".join(
+                    f"{k} = {v:.4f}" for k, v in sorted(row.items())
+                    if k not in ("iter", "time"))
+                log(f"Iteration {it}, lr = {lr:.6g}, {extras}", rank=self.rank)
+            if sp.test_interval and it % sp.test_interval == 0 and \
+                    self.test_nets:
+                for i in range(len(self.test_nets)):
+                    self.test(i)
+                    self.test_metrics[i].flush_row(it)
+
+        if sp.snapshot_after_train:
+            self.snapshot_now()
+        self.stats.add_time("train_total", time.time() - t_start)
+        self._write_artifacts()
+        return last
+
+    # ---------------------------------------------------------------- #
+    def _write_artifacts(self):
+        if self.rank != 0:
+            return
+        name = self.train_net.name or "net"
+        self.metrics.to_csv(os.path.join(self.output_dir,
+                                         f"{name}_train_outputs.csv"))
+        for i, tm in enumerate(self.test_metrics):
+            if tm.rows:
+                tm.to_csv(os.path.join(self.output_dir,
+                                       f"{name}_test{i}_outputs.csv"))
+        self.stats.dump_yaml(os.path.join(self.output_dir, "stats.yaml"))
+
+    def close(self):
+        for p in self.train_pipelines:
+            p.close()
+        for pipes in self.test_pipelines:
+            for p in pipes:
+                p.close()

@@ -1,0 +1,79 @@
+"""Metrics registry: the analog of the reference's net-output PS tables + stats.
+
+The reference aggregates per-display-window training metrics into a PS table
+whose rows are {iter, time, loss, outputs...} and dumps an averaged CSV at the
+end of training (``PrintNetOutputs``, solver.cpp:699-756), plus a YAML stats
+artifact when compiled with -DPETUUM_STATS (stats.hpp). Here metrics come back
+from the compiled step already cross-replica-averaged; this module accumulates
+them per display window and writes the same artifact shapes (CSV + YAML).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+class MetricsTable:
+    def __init__(self, name: str):
+        self.name = name
+        self.rows: List[Dict[str, float]] = []
+        self._window: Dict[str, List[float]] = defaultdict(list)
+        self._t0 = time.time()
+
+    def accumulate(self, metrics: Dict[str, float]) -> None:
+        for k, v in metrics.items():
+            self._window[k].append(float(v))
+
+    def flush_row(self, iteration: int) -> Dict[str, float]:
+        row = {"iter": iteration, "time": round(time.time() - self._t0, 3)}
+        for k, vals in self._window.items():
+            row[k] = sum(vals) / max(len(vals), 1)
+        self._window.clear()
+        self.rows.append(row)
+        return row
+
+    def to_csv(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        cols: List[str] = []
+        for row in self.rows:
+            for k in row:
+                if k not in cols:
+                    cols.append(k)
+        with open(path, "w") as f:
+            f.write(",".join(cols) + "\n")
+            for row in self.rows:
+                f.write(",".join(str(row.get(c, "")) for c in cols) + "\n")
+
+
+class StatsRegistry:
+    """Run-level counters/timers dumped as one YAML per run (stats.hpp analog)."""
+
+    def __init__(self):
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.timers: Dict[str, float] = defaultdict(float)
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        self.counters[name] += value
+
+    def add_time(self, name: str, seconds: float) -> None:
+        self.timers[name] += seconds
+
+    def dump_yaml(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write("counters:\n")
+            for k in sorted(self.counters):
+                f.write(f"  {k}: {self.counters[k]}\n")
+            f.write("timers_sec:\n")
+            for k in sorted(self.timers):
+                f.write(f"  {k}: {round(self.timers[k], 6)}\n")
+
+
+def log(msg: str, *, rank: int = 0) -> None:
+    """Rank-0-only progress logging, the reference's client0/thread0 idiom."""
+    if rank == 0:
+        print(msg, flush=True)

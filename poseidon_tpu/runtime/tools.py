@@ -1,0 +1,132 @@
+"""Dataset tools: convert_imageset / compute_image_mean / partition_data,
+plus the feature extractor.
+
+Parity targets: ``tools/convert_imageset.cpp``, ``tools/compute_image_mean.cpp``,
+``tools/partition_data.cpp`` (LevelDB shard splitter for k clients) and
+``src/caffe/feature_extractor.cpp`` (load weights, forward, dump per-blob
+features). Databases are LMDB (our reader/writer); the reference's default
+LevelDB backend is covered by converting to LMDB.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..proto.wire import Datum, decode_datum, encode_blob, encode_datum
+from .metrics import log
+
+
+def convert_imageset(listfile: str, out_db: str, root_folder: str = "",
+                     resize_height: int = 0, resize_width: int = 0,
+                     shuffle: bool = False, gray: bool = False,
+                     seed: int = 0) -> int:
+    """Image list ('path label' lines) -> LMDB of Datum records."""
+    from PIL import Image
+    from ..data.lmdb_reader import LMDBWriter
+
+    entries = []
+    with open(listfile) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                path, label = line.rsplit(None, 1)
+                entries.append((path, int(label)))
+    if shuffle:
+        np.random.RandomState(seed).shuffle(entries)
+
+    writer = LMDBWriter(out_db)
+    for i, (path, label) in enumerate(entries):
+        img = Image.open(os.path.join(root_folder, path))
+        img = img.convert("L" if gray else "RGB")
+        if resize_height and resize_width:
+            img = img.resize((resize_width, resize_height))
+        arr = np.asarray(img, np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        else:
+            arr = arr[:, :, ::-1]  # RGB -> BGR, Caffe's convention
+        chw = np.ascontiguousarray(arr.transpose(2, 0, 1))
+        datum = Datum(channels=chw.shape[0], height=chw.shape[1],
+                      width=chw.shape[2], data=chw.tobytes(), label=label)
+        writer.put(f"{i:08d}_{os.path.basename(path)}".encode(),
+                   encode_datum(datum))
+    writer.close()
+    log(f"convert_imageset: wrote {len(entries)} records to {out_db}")
+    return len(entries)
+
+
+def compute_image_mean(db_path: str, out_file: str) -> np.ndarray:
+    """LMDB of Datums -> mean BlobProto (.binaryproto)."""
+    from ..data.lmdb_reader import LMDBReader
+    db = LMDBReader(db_path)
+    total: Optional[np.ndarray] = None
+    count = 0
+    for _, value in db:
+        arr = decode_datum(value).to_array()
+        total = arr if total is None else total + arr
+        count += 1
+    if count == 0:
+        raise ValueError(f"{db_path}: empty database")
+    mean = (total / count).astype(np.float32)
+    with open(out_file, "wb") as f:
+        f.write(encode_blob(mean[None]))  # (1, C, H, W)
+    log(f"compute_image_mean: {count} records -> {out_file}")
+    return mean
+
+
+def partition_data(db_path: str, num_shards: int) -> List[str]:
+    """Split a database into contiguous shards '<db>_0' ... '<db>_{k-1}'
+    (the shared_file_system convention, tools/partition_data.cpp)."""
+    from ..data.lmdb_reader import LMDBReader, LMDBWriter
+    db = LMDBReader(db_path)
+    n = len(db)
+    base = n // num_shards
+    rem = n % num_shards
+    out_paths = []
+    idx = 0
+    for s in range(num_shards):
+        take = base + (1 if s < rem else 0)
+        out = f"{db_path.rstrip('/')}_{s}"
+        w = LMDBWriter(out)
+        for _ in range(take):
+            w.put(db.key_at(idx), db.value_at(idx))
+            idx += 1
+        w.close()
+        out_paths.append(out)
+    log(f"partition_data: {n} records -> {num_shards} shards")
+    return out_paths
+
+
+def extract_features(net, params, blob_names: List[str], pipeline,
+                     num_batches: int, out_prefix: str,
+                     mesh=None) -> List[str]:
+    """Forward `num_batches` batches, dump named blobs to one LMDB per blob
+    (feature_extractor.cpp:16-139; features keyed by running sample index)."""
+    import jax
+    from ..data.lmdb_reader import LMDBWriter
+
+    writers = {b: LMDBWriter(f"{out_prefix}_{b.replace('/', '_')}")
+               for b in blob_names}
+    fwd = jax.jit(lambda p, batch: net.apply(p, batch, train=False,
+                                             keep_blobs=True).blobs)
+    sample = 0
+    for _ in range(num_batches):
+        host = next(pipeline)
+        batch = {k: jax.device_put(v) for k, v in host.items()}
+        blobs = fwd(params, batch)
+        n = next(iter(host.values())).shape[0]
+        for b in blob_names:
+            feats = np.asarray(blobs[b], np.float32).reshape(n, -1)
+            for i in range(n):
+                datum = Datum(channels=feats.shape[1], height=1, width=1,
+                              float_data=feats[i])
+                writers[b].put(f"{sample + i:010d}".encode(),
+                               encode_datum(datum))
+        sample += n
+    for b, w in writers.items():
+        w.close()
+    log(f"extract_features: {sample} samples x {len(blob_names)} blobs")
+    return [f"{out_prefix}_{b.replace('/', '_')}" for b in blob_names]
