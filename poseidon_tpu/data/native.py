@@ -1,0 +1,175 @@
+"""ctypes binding for the native data plane (native/poseidon_dataplane.cc).
+
+Builds the shared library on first use (g++, no external deps) and exposes
+``NativeLMDBBatcher``: indexed batch assembly (LMDB read + Datum decode +
+crop/mirror/mean/scale) running multithreaded in C++ with the GIL released —
+the reference's C++ data-layer role. Falls back cleanly when no compiler is
+available (``available()`` returns False and callers use the Python path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "poseidon_dataplane.cc")
+_LIB = os.path.join(_REPO_ROOT, "native", "build",
+                    "libposeidon_dataplane.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+class _TransformSpec(ctypes.Structure):
+    _fields_ = [
+        ("crop_size", ctypes.c_int32),
+        ("mirror", ctypes.c_int32),
+        ("train", ctypes.c_int32),
+        ("scale", ctypes.c_float),
+        ("mean_mode", ctypes.c_int32),
+        ("mean", ctypes.POINTER(ctypes.c_float)),
+    ]
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB):
+            if not os.path.exists(_SRC):
+                _build_failed = True
+                return None
+            try:
+                os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread", "-Wall",
+                     "-shared", "-o", _LIB, _SRC],
+                    check=True, capture_output=True)
+            except (subprocess.CalledProcessError, FileNotFoundError):
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_LIB)
+        lib.pdp_open.restype = ctypes.c_void_p
+        lib.pdp_open.argtypes = [ctypes.c_char_p]
+        lib.pdp_error.restype = ctypes.c_char_p
+        lib.pdp_error.argtypes = [ctypes.c_void_p]
+        lib.pdp_count.restype = ctypes.c_int64
+        lib.pdp_count.argtypes = [ctypes.c_void_p]
+        lib.pdp_shape.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_int32)] * 3
+        lib.pdp_batch.restype = ctypes.c_int32
+        lib.pdp_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(_TransformSpec), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.pdp_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeLMDBBatcher:
+    def __init__(self, path: str, *, crop_size: int = 0, mirror: bool = False,
+                 train: bool = True, scale: float = 1.0,
+                 mean: Optional[np.ndarray] = None,
+                 mean_values: Optional[np.ndarray] = None,
+                 n_threads: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native data plane unavailable (no compiler?)")
+        self._lib = lib
+        self._h = lib.pdp_open(path.encode())
+        err = lib.pdp_error(self._h)
+        if err:
+            msg = err.decode()
+            lib.pdp_close(self._h)
+            self._h = None
+            raise IOError(f"{path}: {msg}")
+        c = ctypes.c_int32()
+        h = ctypes.c_int32()
+        w = ctypes.c_int32()
+        lib.pdp_shape(self._h, ctypes.byref(c), ctypes.byref(h),
+                      ctypes.byref(w))
+        self.record_shape = (c.value, h.value, w.value)
+        self.n = int(lib.pdp_count(self._h))
+        self.n_threads = n_threads or min(8, os.cpu_count() or 1)
+
+        if crop_size and (crop_size > self.record_shape[1]
+                          or crop_size > self.record_shape[2]):
+            self._lib.pdp_close(self._h)
+            self._h = None
+            raise ValueError(
+                f"crop_size {crop_size} exceeds record "
+                f"{self.record_shape[1]}x{self.record_shape[2]}")
+        mean_mode = 0
+        self._mean_buf = None
+        if mean is not None:
+            m = np.ascontiguousarray(np.asarray(mean, np.float32).reshape(-1))
+            if m.size != int(np.prod(self.record_shape)):
+                raise ValueError("mean array size mismatch")
+            self._mean_buf = m
+            mean_mode = 2
+        elif mean_values is not None and len(mean_values):
+            m = np.asarray(mean_values, np.float32)
+            if m.size == 1:
+                m = np.repeat(m, self.record_shape[0])
+            if m.size != self.record_shape[0]:
+                raise ValueError("mean_values arity mismatch")
+            self._mean_buf = np.ascontiguousarray(m)
+            mean_mode = 1
+        mean_ptr = self._mean_buf.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)) if self._mean_buf is not None \
+            else ctypes.POINTER(ctypes.c_float)()
+        self._spec = _TransformSpec(
+            crop_size=crop_size, mirror=int(mirror), train=int(train),
+            scale=scale, mean_mode=mean_mode, mean=mean_ptr)
+        ch, hh, ww = self.record_shape
+        self.out_shape = (ch, crop_size or hh, crop_size or ww)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def batch(self, indices: np.ndarray,
+              seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+        idx = np.ascontiguousarray(indices, np.int64)
+        n = len(idx)
+        data = np.empty((n,) + self.out_shape, np.float32)
+        labels = np.empty((n,), np.int32)
+        rc = self._lib.pdp_batch(
+            self._h, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            ctypes.byref(self._spec), ctypes.c_uint64(seed),
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self.n_threads)
+        if rc == -2:
+            raise IndexError("batch index out of range")
+        if rc == -3:
+            raise ValueError("crop_size exceeds record dimensions")
+        if rc != 0:
+            raise IOError(f"native batch failed: bad record (rc={rc})")
+        return data, labels
+
+    def close(self):
+        if self._h is not None:
+            self._lib.pdp_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

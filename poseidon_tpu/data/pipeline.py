@@ -107,28 +107,64 @@ class BatchPipeline:
         seed: int = 0,
         shuffle: Optional[bool] = None,
         memory_data: Optional[Dict[str, np.ndarray]] = None,
+        use_native: bool = True,
     ):
         self.lp = lp
-        self.source = build_source(lp, shard, memory_data)
-        self.transformer = DataTransformer(_effective_transform(lp), phase,
-                                           seed=seed)
+        self.phase = phase
         self.batch_size = batch_size
         self.shard = shard
         self.seed = seed
         self.shuffle = (phase == "TRAIN") if shuffle is None else shuffle
         self.tops = list(lp.top)
-        c, h, w = self.source.record_shape
-        self.data_shape = (batch_size,) + self.transformer.output_shape(c, h, w)
+
+        self.native = self._try_native(lp, phase, shard) if use_native else None
+        if self.native is not None:
+            self.source = None
+            self._n_records = len(self.native)
+            self.data_shape = (batch_size,) + self.native.out_shape
+        else:
+            self.source = build_source(lp, shard, memory_data)
+            self._n_records = len(self.source)
+            self.transformer = DataTransformer(_effective_transform(lp), phase,
+                                               seed=seed)
+            c, h, w = self.source.record_shape
+            self.data_shape = (batch_size,) + \
+                self.transformer.output_shape(c, h, w)
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._stop = threading.Event()
         self._thread.start()
 
+    def _try_native(self, lp: LayerParameter, phase: str, shard: Shard):
+        """C++ fast path for LMDB-backed DATA layers (native/...dataplane.cc);
+        any failure falls back to the Python source."""
+        if lp.canonical_type() != "DATA":
+            return None
+        try:
+            from .native import NativeLMDBBatcher, available
+            if not available():
+                return None
+            dp = lp.data_param
+            path = sharded_source_path(dp.source, shard.index,
+                                       dp.shared_file_system)
+            tp = _effective_transform(lp)
+            mean = None
+            if tp.mean_file:
+                from ..proto.wire import read_blob_file
+                mean = read_blob_file(tp.mean_file)[0]
+            return NativeLMDBBatcher(
+                path, crop_size=tp.crop_size, mirror=tp.mirror,
+                train=(phase == "TRAIN"), scale=tp.scale, mean=mean,
+                mean_values=np.asarray(tp.mean_value, np.float32)
+                if tp.mean_value else None)
+        except Exception:
+            return None
+
     # ------------------------------------------------------------------ #
     def _index_stream(self) -> Iterator[int]:
         epoch = 0
         while True:
-            idx = shard_indices(len(self.source), self.shard, epoch,
+            idx = shard_indices(self._n_records, self.shard, epoch,
                                 self.shuffle, self.seed)
             if len(idx) == 0:
                 raise RuntimeError("shard received zero records")
@@ -137,16 +173,27 @@ class BatchPipeline:
 
     def _worker(self):
         stream = self._index_stream()
+        batch_no = 0
         try:
             while not self._stop.is_set():
-                raw = np.empty((self.batch_size,) + self.source.record_shape,
-                               np.float32)
-                labels = np.empty((self.batch_size,), np.int32)
-                for i in range(self.batch_size):
-                    arr, label = self.source.read(next(stream))
-                    raw[i] = arr
-                    labels[i] = label
-                batch = {self.tops[0]: self.transformer(raw)}
+                idx = np.fromiter((next(stream)
+                                   for _ in range(self.batch_size)),
+                                  np.int64, count=self.batch_size)
+                if self.native is not None:
+                    data, labels = self.native.batch(
+                        idx, seed=self.seed * 1_000_003 + batch_no)
+                else:
+                    raw = np.empty(
+                        (self.batch_size,) + self.source.record_shape,
+                        np.float32)
+                    labels = np.empty((self.batch_size,), np.int32)
+                    for i, j in enumerate(idx):
+                        arr, label = self.source.read(int(j))
+                        raw[i] = arr
+                        labels[i] = label
+                    data = self.transformer(raw)
+                batch_no += 1
+                batch = {self.tops[0]: data}
                 if len(self.tops) > 1:
                     batch[self.tops[1]] = labels
                 self._queue.put(batch)
