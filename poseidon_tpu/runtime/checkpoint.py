@@ -56,13 +56,19 @@ def _unflatten(flat: Dict[str, np.ndarray]):
 
 
 def snapshot(prefix: str, net: Net, params, state: TrainState) -> Tuple[str, str]:
+    """Write both artifacts atomically (tmp + rename): with replicated state
+    every rank writes identical bytes, so even concurrent snapshots to a
+    shared filesystem are safe — the last rename wins with valid content."""
     it = int(state.solver.it)
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
     model_path = f"{prefix}_iter_{it}.caffemodel"
     state_path = f"{prefix}_iter_{it}.solverstate.npz"
+    pid = os.getpid()
 
-    with open(model_path, "wb") as f:
+    tmp = f"{model_path}.tmp.{pid}"
+    with open(tmp, "wb") as f:
         f.write(encode_caffemodel(net.name or "net", net.export_weights(params)))
+    os.replace(tmp, model_path)
 
     arrays = {}
     arrays.update({f"params/{k}": v for k, v in _flatten(params).items()})
@@ -71,7 +77,10 @@ def snapshot(prefix: str, net: Net, params, state: TrainState) -> Tuple[str, str
     arrays.update({f"comm_error/{k}": v
                    for k, v in _flatten(state.comm_error).items()})
     arrays["iter"] = np.asarray(it)
-    np.savez(state_path, **arrays)
+    tmp = f"{state_path}.tmp.{pid}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, state_path)
     return model_path, state_path
 
 

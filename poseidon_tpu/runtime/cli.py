@@ -50,6 +50,9 @@ def _engine_from_args(args, phase_nets=True):
 
 
 def cmd_train(args) -> int:
+    from .cluster import init_distributed
+    init_distributed(hostfile=args.hostfile or None,
+                     node_id=args.node_id if args.node_id >= 0 else None)
     eng = _engine_from_args(args)
     if args.snapshot:
         eng.restore_from(args.snapshot)
@@ -216,6 +219,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sfb-auto", action="store_true",
                    help="pick SFB per FC layer by cost model (SACP)")
     t.add_argument("--grad-reduce", default="mean", choices=["mean", "sum"])
+    t.add_argument("--hostfile", default="",
+                   help="cluster hostfile ('<id> <ip> <port>' lines)")
+    t.add_argument("--node_id", type=int, default=-1,
+                   help="this process's hostfile id")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="score a model")

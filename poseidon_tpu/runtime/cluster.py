@@ -1,0 +1,81 @@
+"""Cluster control plane: hostfile topology + JAX distributed runtime init.
+
+The reference's control plane is a hostfile ("<id> <ip> <port>" lines,
+machinefiles/localserver) plus a name-node rendezvous thread on client 0
+(ps/src/petuum_ps/server/name_node_thread.cpp:57-90) over a ZeroMQ router
+mesh. The TPU-native equivalent: the same hostfile names the processes, host 0
+is the JAX distributed coordinator (the name-node role), and the data plane is
+XLA collectives over ICI/DCN compiled into the step — no bg workers, no server
+shards, no oplog wire protocol.
+
+Fail-fast semantics match the reference (comm_bus.hpp:22-24): any rendezvous
+or collective error aborts the process; recovery is via checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class Host:
+    id: int
+    ip: str
+    port: int
+
+
+def parse_hostfile(path: str) -> List[Host]:
+    hosts: List[Host] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 3:
+                raise ValueError(f"{path}: bad hostfile line {line!r} "
+                                 f"(want '<id> <ip> <port>')")
+            hosts.append(Host(int(parts[0]), parts[1], int(parts[2])))
+    ids = [h.id for h in hosts]
+    if ids != list(range(len(hosts))):
+        raise ValueError(f"{path}: host ids must be 0..N-1 in order, got {ids}")
+    return hosts
+
+
+def init_distributed(hostfile: Optional[str] = None,
+                     node_id: Optional[int] = None,
+                     coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None) -> int:
+    """Initialize the JAX distributed runtime from a hostfile (or explicit
+    coordinator config / env). Host 0's entry is the coordinator — the
+    name-node analog. Returns this process's id. No-op when single-process."""
+    import jax
+
+    if hostfile is not None:
+        hosts = parse_hostfile(hostfile)
+        if len(hosts) == 1:
+            return 0
+        if node_id is None:
+            raise ValueError("node_id is required with a multi-host hostfile")
+        coord = f"{hosts[0].ip}:{hosts[0].port}"
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=len(hosts),
+                                   process_id=node_id)
+        return node_id
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=node_id)
+        return node_id or 0
+    # Env-driven: the scripts/launch.py --local path sets these.
+    coord = os.environ.get("POSEIDON_COORDINATOR")
+    if coord:
+        n = int(os.environ["POSEIDON_NUM_PROCS"])
+        pid = int(os.environ["POSEIDON_PROC_ID"])
+        if n > 1:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=n, process_id=pid)
+        return pid
+    return 0

@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Kill stray training processes on every hostfile node (scripts/kill_caffe.py analog)."""
+import argparse
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from poseidon_tpu.runtime.cluster import parse_hostfile  # noqa: E402
+
+ap = argparse.ArgumentParser()
+ap.add_argument("hostfile")
+args = ap.parse_args()
+for h in parse_hostfile(args.hostfile):
+    subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no", h.ip,
+                    "pkill -f '[p]oseidon_tpu' || true"])
