@@ -117,6 +117,20 @@ class BatchPipeline:
         self.shuffle = (phase == "TRAIN") if shuffle is None else shuffle
         self.tops = list(lp.top)
 
+        self.window = None
+        if lp.canonical_type() == "WINDOW_DATA":
+            from .window import WindowDataSource
+            self.window = WindowDataSource(
+                lp, phase, seed=seed * shard.count + shard.index)
+            self.native = None
+            self.source = None
+            self._n_records = len(self.window.fg) + len(self.window.bg)
+            self.data_shape = (batch_size,) + self.window.record_shape
+            self._queue = queue.Queue(maxsize=prefetch)
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._stop = threading.Event()
+            self._thread.start()
+            return
         self.native = self._try_native(lp, phase, shard) if use_native else None
         if self.native is not None:
             self.source = None
@@ -172,6 +186,17 @@ class BatchPipeline:
             epoch += 1
 
     def _worker(self):
+        if self.window is not None:
+            try:
+                while not self._stop.is_set():
+                    data, labels = self.window.batch(self.batch_size)
+                    batch = {self.tops[0]: data}
+                    if len(self.tops) > 1:
+                        batch[self.tops[1]] = labels
+                    self._queue.put(batch)
+            except Exception as e:
+                self._queue.put(e)
+            return
         stream = self._index_stream()
         batch_no = 0
         try:

@@ -1,1 +1,2 @@
 
+from .attention import attention  # noqa: F401

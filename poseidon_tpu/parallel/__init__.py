@@ -7,3 +7,4 @@ from .trainer import (  # noqa: F401
     TrainState, build_eval_step, build_ssp_train_step, build_train_step,
     init_ssp_state, init_train_state, param_mults,
 )
+from .sequence import ring_attention, ulysses_attention  # noqa: F401

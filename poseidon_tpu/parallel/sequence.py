@@ -1,0 +1,97 @@
+"""Sequence/context parallelism: ring attention + all-to-all (Ulysses) style.
+
+Long sequences are sharded across a mesh axis; two exchange strategies cover
+the design space the way SFB-vs-dense covers gradients:
+
+**Ring attention** (blockwise attention over a ppermute ring): each device
+holds a contiguous (B, H, S/n, D) slice of Q, K, V. K/V blocks rotate around
+the ring; every device folds each arriving block into the online-softmax
+accumulator (ops/attention.py). Comm is O(S/n * D) per step over n steps and
+rides ICI neighbor links; compute overlaps the rotation since XLA schedules
+the next ppermute alongside the current block matmul. Causal masking is
+applied at block granularity from the rotating source-shard index.
+
+**All-to-all (Ulysses)**: one all_to_all re-shards from sequence-sharded to
+head-sharded, each device runs dense attention for its H/n heads over the
+FULL sequence, and a second all_to_all restores sequence sharding. Two
+collective hops total — cheaper than the ring when heads >= devices and the
+full-sequence scores fit in HBM.
+
+Both are exact: tests check they match full attention on the gathered
+sequence to float tolerance, under jit + shard_map on the virtual mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import (NEG_INF, attention, block_attend,
+                             finalize_block_acc, init_block_acc)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str,
+                   *, causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Blockwise ring attention inside shard_map; q,k,v: (B, H, S_local, D)
+    sequence-sharded along `axis`. Returns the local output block."""
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, h, s_local, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        state, kb, vb = carry
+        src = (my - i) % n  # which global block this k/v slice is
+        if causal:
+            # block-level mask: future blocks fully masked; the diagonal
+            # block gets the in-block causal triangle.
+            within = jnp.tril(jnp.ones((s_local, s_local), bool))
+            bias = jnp.where(
+                src < my, 0.0,
+                jnp.where(src == my,
+                          jnp.where(within, 0.0, NEG_INF),
+                          NEG_INF))
+            bias = jnp.broadcast_to(bias, (b, h, s_local, s_local))
+        else:
+            bias = None
+        state = block_attend(state, q, kb, vb, scale, bias)
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return (state, kb, vb), None
+
+    init = (init_block_acc(b, h, s_local, d), k, v)
+    (state, _, _), _ = lax.scan(step, init, jnp.arange(n))
+    return finalize_block_acc(state, q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str,
+                      *, causal: bool = False,
+                      scale: Optional[float] = None) -> jax.Array:
+    """All-to-all sequence parallelism inside shard_map; q,k,v:
+    (B, H, S_local, D) with H divisible by the axis size. Returns the local
+    sequence block of the output."""
+    n = lax.psum(1, axis)
+    b, h, s_local, d = q.shape
+    if h % n:
+        raise ValueError(f"heads ({h}) must divide by axis size ({n})")
+
+    def seq_to_heads(x):
+        # (B, H, S/n, D) -> (B, H/n, S, D). Tiled all_to_all splits the head
+        # axis across devices and concatenates sequence blocks in source-
+        # device order, which IS global sequence order.
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    def heads_to_seq(x):
+        # inverse: (B, H/n, S, D) -> (B, H, S/n, D), heads restored to global
+        # order since device j contributed head group j.
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)

@@ -54,6 +54,7 @@ def cmd_train(args) -> int:
     init_distributed(hostfile=args.hostfile or None,
                      node_id=args.node_id if args.node_id >= 0 else None)
     eng = _engine_from_args(args)
+    eng.profile_steps = args.profile
     if args.snapshot:
         eng.restore_from(args.snapshot)
     elif args.weights:
@@ -223,6 +224,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cluster hostfile ('<id> <ip> <port>' lines)")
     t.add_argument("--node_id", type=int, default=-1,
                    help="this process's hostfile id")
+    t.add_argument("--profile", type=int, default=0,
+                   help="capture an xplane trace over N steps (from step 10)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="score a model")

@@ -112,6 +112,22 @@ class Engine:
         self.metrics = MetricsTable("train")
         self.test_metrics = [MetricsTable(f"test_{i}")
                              for i in range(len(self.test_nets))]
+        self.profile_steps = 0  # set >0 to capture an xplane trace
+
+        # HDF5_OUTPUT layers (hdf5_output_layer.cpp): dump their bottoms
+        # during test passes; side-effecting IO stays outside the traced step.
+        if any(l.TYPE == "HDF5_OUTPUT" for l in self.train_net.layers):
+            log("WARNING: HDF5_OUTPUT in the TRAIN net is not dumped "
+                "(supported in TEST nets only)", rank=self.rank)
+        self._h5_outputs = [
+            [(l.lp.hdf5_output_param.file_name, list(l.lp.bottom))
+             for l in net.layers if l.TYPE == "HDF5_OUTPUT"]
+            for net in self.test_nets]
+        self._h5_fetch = [
+            (jax.jit(lambda p, b, _n=net: _n.apply(p, b, train=False,
+                                                   keep_blobs=True).blobs)
+             if any(outs) else None)
+            for net, outs in zip(self.test_nets, self._h5_outputs)]
 
     # ---------------------------------------------------------------- #
     def _build_pipelines(self, net_param: NetParameter, phase: str):
@@ -163,11 +179,32 @@ class Engine:
         iters = self.sp.test_iter[test_id] if test_id < len(self.sp.test_iter) \
             else 50
         acc: Dict[str, float] = {}
+        h5_acc: Dict[str, list] = {}
+        h5_specs = self._h5_outputs[test_id]
+        multihost = jax.process_count() > 1
         for _ in range(iters):
             batch = self._next_batch(self.test_pipelines[test_id])
-            m = ev(self.params, batch)
+            if h5_specs:
+                # one traced forward serves both metrics and dumped blobs
+                blobs = self._h5_fetch[test_id](self.params, batch)
+                m = {k: v for k, v in blobs.items()
+                     if k in net.output_names and v.ndim == 0}
+                for fname, bottoms in h5_specs:
+                    for b in bottoms:
+                        arr = blobs[b]
+                        if multihost:
+                            from jax.experimental import multihost_utils
+                            arr = multihost_utils.process_allgather(
+                                arr, tiled=True)
+                        if self.rank == 0:
+                            h5_acc.setdefault(f"{fname}\x00{b}", []).append(
+                                np.asarray(arr))
+            else:
+                m = ev(self.params, batch)
             for k, v in m.items():
                 acc[k] = acc.get(k, 0.0) + float(v)
+        if h5_specs and self.rank == 0:
+            self._write_h5_outputs(h5_acc)
         out = {k: v / iters for k, v in acc.items()}
         msg = ", ".join(f"{k} = {v:.4f}" for k, v in sorted(out.items()))
         log(f"    Test net #{test_id}: {msg}", rank=self.rank)
@@ -180,6 +217,9 @@ class Engine:
         it = int(self.state.solver.it)
         t_start = time.time()
         last: Dict[str, float] = {}
+        # profiler window: skip a couple of warmup/compile steps
+        profile_start = it + 2
+        profiling = False
 
         if sp.test_interval and sp.test_initialization and self.test_nets:
             for i in range(len(self.test_nets)):
@@ -189,11 +229,22 @@ class Engine:
         while it < max_iter:
             if sp.snapshot and it > 0 and it % sp.snapshot == 0:
                 self.snapshot_now()
+            if self.profile_steps and it == profile_start:
+                jax.profiler.start_trace(
+                    os.path.join(self.output_dir, "profile"))
+                profiling = True
             batch = self._next_batch(self.train_pipelines)
             t0 = time.time()
             self.params, self.state, m = self.train_step.step(
                 self.params, self.state, batch, jax.random.fold_in(self.rng, it))
             it += 1
+            if profiling and it >= profile_start + self.profile_steps:
+                jax.block_until_ready(m["loss"])
+                jax.profiler.stop_trace()
+                profiling = False
+                log(f"Wrote profiler trace to "
+                    f"{os.path.join(self.output_dir, 'profile')}",
+                    rank=self.rank)
             last = {k: float(v) for k, v in m.items()}
             self.metrics.accumulate(last)
             self.stats.add("train_iters")
@@ -212,11 +263,30 @@ class Engine:
                     self.test(i)
                     self.test_metrics[i].flush_row(it)
 
+        if profiling:
+            jax.profiler.stop_trace()
+            log(f"Wrote profiler trace to "
+                f"{os.path.join(self.output_dir, 'profile')}", rank=self.rank)
         if sp.snapshot_after_train:
             self.snapshot_now()
         self.stats.add_time("train_total", time.time() - t_start)
         self._write_artifacts()
         return last
+
+    def _write_h5_outputs(self, h5_acc: Dict[str, list]):
+        import h5py
+        by_file: Dict[str, Dict[str, np.ndarray]] = {}
+        for key, chunks in h5_acc.items():
+            fname, blob = key.split("\x00")
+            by_file.setdefault(fname, {})[blob.replace("/", "_")] = \
+                np.concatenate(chunks)
+        for fname, datasets in by_file.items():
+            path = os.path.join(self.output_dir, fname)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with h5py.File(path, "w") as f:
+                for name, arr in datasets.items():
+                    f.create_dataset(name, data=arr)
+            log(f"HDF5 output -> {path}", rank=self.rank)
 
     # ---------------------------------------------------------------- #
     def _write_artifacts(self):

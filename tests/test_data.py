@@ -188,3 +188,66 @@ def test_synthetic_source_learnable():
     assert a0.shape == (1, 4, 4)
     # same class, different noise
     assert not np.allclose(a0, a3)
+
+
+def test_window_data_source(tmp_path):
+    from PIL import Image
+    from poseidon_tpu.data.window import WindowDataSource
+    from poseidon_tpu.proto.messages import (LayerParameter,
+                                             TransformationParameter,
+                                             WindowDataParameter)
+    rs = np.random.RandomState(0)
+    img_paths = []
+    for i in range(2):
+        img = Image.fromarray(rs.randint(0, 255, (40, 40, 3)).astype(np.uint8))
+        p = tmp_path / f"w{i}.png"
+        img.save(p)
+        img_paths.append(str(p))
+    wf = tmp_path / "windows.txt"
+    wf.write_text(f"""# 0
+{img_paths[0]}
+3 40 40
+3
+1 0.9 5 5 20 20
+2 0.7 10 10 30 30
+0 0.1 0 0 10 10
+# 1
+{img_paths[1]}
+3 40 40
+2
+1 0.8 0 0 15 15
+0 0.05 20 20 39 39
+""")
+    lp = LayerParameter(
+        name="wd", type="WINDOW_DATA", top=["data", "label"],
+        window_data_param=WindowDataParameter(
+            source=str(wf), batch_size=8, crop_size=12, fg_threshold=0.5,
+            bg_threshold=0.3, fg_fraction=0.5, context_pad=2),
+        transform_param=TransformationParameter(crop_size=12))
+    src = WindowDataSource(lp, "TRAIN")
+    assert len(src.fg) == 3 and len(src.bg) == 2
+    data, labels = src.batch(8)
+    assert data.shape == (8, 3, 12, 12)
+    assert set(labels[:4]) <= {1, 2}   # fg half
+    assert set(labels[4:]) == {0}      # bg half
+
+    from poseidon_tpu.data.pipeline import BatchPipeline
+    pipe = BatchPipeline(lp, "TRAIN", 8)
+    b = next(pipe)
+    assert b["data"].shape == (8, 3, 12, 12)
+    pipe.close()
+
+
+def test_libsvm_parser(tmp_path):
+    from poseidon_tpu.data.libsvm import read_libsvm
+    f = tmp_path / "data.svm"
+    f.write_text("""1 1:0.5 3:1.5
+-1 2:2.0 # comment
+1 1:1.0 4:0.25
+""")
+    feats, labels = read_libsvm(str(f))
+    np.testing.assert_allclose(labels, [1, -1, 1])
+    dense = feats.to_dense()
+    assert dense.shape == (3, 4)
+    np.testing.assert_allclose(dense[0], [0.5, 0, 1.5, 0])
+    np.testing.assert_allclose(dense[1], [0, 2.0, 0, 0])
