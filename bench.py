@@ -35,14 +35,20 @@ def main() -> None:
     # MXU-native numerics for the perf path.
     config.set_policy(compute_dtype=jnp.bfloat16)
 
+    import os
     n_dev = jax.device_count()
-    per_dev_batch = 256
+    # env knobs let CI smoke-test the exact bench path at tiny sizes
+    per_dev_batch = int(os.environ.get("POSEIDON_BENCH_BATCH", "256"))
+    image = int(os.environ.get("POSEIDON_BENCH_IMAGE", "227"))
+    classes = int(os.environ.get("POSEIDON_BENCH_CLASSES", "1000"))
+    iters = int(os.environ.get("POSEIDON_BENCH_ITERS", "20"))
     batch = per_dev_batch * n_dev
     mesh = make_mesh()
 
-    shapes = {"data": (per_dev_batch, 3, 227, 227), "label": (per_dev_batch,)}
-    net = Net(zoo.alexnet(with_accuracy=False), phase="TRAIN",
-              source_shapes=shapes)
+    shapes = {"data": (per_dev_batch, 3, image, image),
+              "label": (per_dev_batch,)}
+    net = Net(zoo.alexnet(num_classes=classes, with_accuracy=False),
+              phase="TRAIN", source_shapes=shapes)
     sp = SolverParameter(base_lr=0.01, lr_policy="step", gamma=0.1,
                          stepsize=100000, momentum=0.9, weight_decay=5e-4)
     comm = CommConfig(layer_strategies={"fc6": SFB, "fc7": SFB})
@@ -51,9 +57,9 @@ def main() -> None:
     params = net.init(jax.random.PRNGKey(0))
     state = init_train_state(params, comm, n_dev)
     rs = np.random.RandomState(0)
-    data = jnp.asarray(rs.rand(batch, 3, 227, 227).astype(np.float32),
+    data = jnp.asarray(rs.rand(batch, 3, image, image).astype(np.float32),
                        device=ts.batch_sharding)
-    label = jnp.asarray(rs.randint(0, 1000, size=(batch,)),
+    label = jnp.asarray(rs.randint(0, classes, size=(batch,)),
                         device=ts.batch_sharding)
     batch_dict = {"data": data, "label": label}
     rng = jax.random.PRNGKey(1)
@@ -62,7 +68,6 @@ def main() -> None:
     params, state, m = ts.step(params, state, batch_dict, rng)
     jax.block_until_ready(m["loss"])
 
-    iters = 20
     t0 = time.perf_counter()
     for i in range(iters):
         params, state, m = ts.step(params, state, batch_dict, rng)

@@ -325,3 +325,81 @@ ZOO = {
     "alexnet": (alexnet, alexnet_shapes),
     "googlenet": (googlenet, googlenet_shapes),
 }
+
+
+# --------------------------------------------------------------------------- #
+# CaffeNet (models/bvlc_reference_caffenet) — AlexNet variant with
+# pool-before-norm ordering; also the backbone of the reference's R-CNN
+# (models/bvlc_reference_rcnn_ilsvrc13) and flickr-style finetuning models.
+# --------------------------------------------------------------------------- #
+
+def caffenet(num_classes: int = 1000, with_accuracy: bool = True,
+             classifier_name: str = "fc8") -> NetParameter:
+    layers = [
+        conv("conv1", "data", "conv1", 96, 11, stride=4,
+             weight_filler=gaussian(0.01)),
+        relu("relu1", "conv1"),
+        pool("pool1", "conv1", "pool1", "MAX", 3, 2),
+        lrn("norm1", "pool1", "norm1"),
+        conv("conv2", "norm1", "conv2", 256, 5, pad=2, group=2,
+             weight_filler=gaussian(0.01), bias_value=1.0),
+        relu("relu2", "conv2"),
+        pool("pool2", "conv2", "pool2", "MAX", 3, 2),
+        lrn("norm2", "pool2", "norm2"),
+        conv("conv3", "norm2", "conv3", 384, 3, pad=1,
+             weight_filler=gaussian(0.01)),
+        relu("relu3", "conv3"),
+        conv("conv4", "conv3", "conv4", 384, 3, pad=1, group=2,
+             weight_filler=gaussian(0.01), bias_value=1.0),
+        relu("relu4", "conv4"),
+        conv("conv5", "conv4", "conv5", 256, 3, pad=1, group=2,
+             weight_filler=gaussian(0.01), bias_value=1.0),
+        relu("relu5", "conv5"),
+        pool("pool5", "conv5", "pool5", "MAX", 3, 2),
+        ip("fc6", "pool5", "fc6", 4096, weight_filler=gaussian(0.005),
+           bias_value=1.0),
+        relu("relu6", "fc6"),
+        dropout("drop6", "fc6", 0.5),
+        ip("fc7", "fc6", "fc7", 4096, weight_filler=gaussian(0.005),
+           bias_value=1.0),
+        relu("relu7", "fc7"),
+        dropout("drop7", "fc7", 0.5),
+        ip(classifier_name, "fc7", classifier_name, num_classes,
+           weight_filler=gaussian(0.01)),
+        softmax_loss("loss", [classifier_name, "label"]),
+    ]
+    if with_accuracy:
+        layers.insert(-1, accuracy("accuracy", [classifier_name, "label"]))
+    return NetParameter(name="CaffeNet", layers=layers)
+
+
+def caffenet_shapes(batch: int) -> Dict[str, tuple]:
+    return {"data": (batch, 3, 227, 227), "label": (batch,)}
+
+
+def rcnn_ilsvrc13(num_classes: int = 200) -> NetParameter:
+    """R-CNN detection head (models/bvlc_reference_rcnn_ilsvrc13): CaffeNet
+    backbone scoring warped window crops; trains from WINDOW_DATA."""
+    net = caffenet(num_classes=num_classes, with_accuracy=True,
+                   classifier_name="fc-rcnn")
+    net.name = "R-CNN-ilsvrc13"
+    return net
+
+
+def finetune_flickr_style(num_classes: int = 20) -> NetParameter:
+    """Finetuning recipe (models/finetune_flickr_style upstream): CaffeNet
+    with a fresh, faster-learning classifier layer."""
+    net = caffenet(num_classes=num_classes, with_accuracy=True,
+                   classifier_name="fc8_flickr")
+    for lp in net.layers:
+        if lp.name == "fc8_flickr":
+            lp.blobs_lr = [10.0, 20.0]  # fresh head learns 10x faster
+    net.name = "FlickrStyleCaffeNet"
+    return net
+
+
+ZOO.update({
+    "caffenet": (caffenet, caffenet_shapes),
+    "rcnn_ilsvrc13": (rcnn_ilsvrc13, caffenet_shapes),
+    "finetune_flickr_style": (finetune_flickr_style, caffenet_shapes),
+})

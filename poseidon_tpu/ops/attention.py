@@ -40,7 +40,6 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     s = lax.dot_general(
         q.astype(p.compute_dtype), k.astype(p.compute_dtype),
         (((3,), (3,)), ((0, 1), (0, 1))),
-        preferred_element_type=p.accum_dtype,
         precision=matmul_precision()) * scale
     if bias is not None:
         s = s + bias
@@ -52,7 +51,6 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return lax.dot_general(
         w.astype(p.compute_dtype), v.astype(p.compute_dtype),
         (((3,), (2,)), ((0, 1), (0, 1))),
-        preferred_element_type=p.accum_dtype,
         precision=matmul_precision()).astype(q.dtype)
 
 
@@ -77,7 +75,6 @@ def block_attend(state: BlockAcc, q, k, v, scale: float,
     s = lax.dot_general(
         q.astype(p.compute_dtype), k.astype(p.compute_dtype),
         (((3,), (3,)), ((0, 1), (0, 1))),
-        preferred_element_type=p.accum_dtype,
         precision=matmul_precision()) * scale
     if bias is not None:
         s = s + bias
@@ -90,7 +87,6 @@ def block_attend(state: BlockAcc, q, k, v, scale: float,
     pv = lax.dot_general(
         probs.astype(p.compute_dtype), v.astype(p.compute_dtype),
         (((3,), (2,)), ((0, 1), (0, 1))),
-        preferred_element_type=p.accum_dtype,
         precision=matmul_precision()).astype(jnp.float32)
     acc_new = state.acc * alpha[..., None] + pv
     return BlockAcc(acc=acc_new, m=m_new, l=l_new)

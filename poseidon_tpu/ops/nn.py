@@ -57,7 +57,6 @@ def conv2d(
         padding=[(pad[0], pad[0]), (pad[1], pad[1])],
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=group,
-        preferred_element_type=p.accum_dtype,
         precision=matmul_precision(),
     )
     if b is not None:
@@ -207,7 +206,6 @@ def inner_product(x: jax.Array, w: jax.Array, b: Optional[jax.Array]) -> jax.Arr
         x2.astype(p.compute_dtype),
         w.astype(p.compute_dtype),
         (((1,), (1,)), ((), ())),
-        preferred_element_type=p.accum_dtype,
         precision=matmul_precision(),
     )
     if b is not None:

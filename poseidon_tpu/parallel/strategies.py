@@ -98,7 +98,6 @@ def _sfb_matmul(axis: str, reduce: str, with_bias: bool):
         y = lax.dot_general(
             x2.astype(p.compute_dtype), w.astype(p.compute_dtype),
             (((1,), (1,)), ((), ())),
-            preferred_element_type=p.accum_dtype,
             precision=matmul_precision())
         if with_bias:
             y = y + b.astype(y.dtype)
@@ -118,7 +117,6 @@ def _sfb_matmul(axis: str, reduce: str, with_bias: bool):
         gx = lax.dot_general(
             g.astype(p.compute_dtype), w.astype(p.compute_dtype),
             (((1,), (0,)), ((), ())),
-            preferred_element_type=p.accum_dtype,
             precision=matmul_precision()).astype(x2.dtype)
         # sufficient factors: a = top diff (B, M), b = bottom data (B, K)
         G = lax.all_gather(g, axis, tiled=True)       # (B_global, M)
@@ -126,7 +124,6 @@ def _sfb_matmul(axis: str, reduce: str, with_bias: bool):
         gw = lax.dot_general(
             G.astype(p.compute_dtype), X.astype(p.compute_dtype),
             (((0,), (0,)), ((), ())),
-            preferred_element_type=p.accum_dtype,
             precision=matmul_precision())     # (M, K) — global sum
         gw = _maybe_mean(gw, axis, reduce).astype(w.dtype)
         if with_bias:
