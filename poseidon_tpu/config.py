@@ -1,9 +1,14 @@
 """Global numeric policy (the TPU analog of Caffe's Dtype template parameter).
 
-Parameters and accumulations stay float32; matmul/conv inputs are cast to
-``compute_dtype`` (bfloat16 by default on TPU — the MXU's native format) with
-float32 accumulation via ``preferred_element_type``. Set compute dtype to
-float32 for golden-value numerics tests against Caffe semantics.
+Parameters and optimizer state stay float32. Forward/backward matmul and conv
+inputs are cast to ``compute_dtype`` (bfloat16 for TPU perf configs; the MXU
+accumulates bf16 products in f32 internally) and produce compute-dtype
+activations — forcing f32 outputs via preferred_element_type breaks conv
+transposes under autodiff, so it is used only where autodiff never looks:
+custom_vjp backward dots (SFB gradient reconstruction) and softmax/online-
+softmax statistics, which are always f32 (``accum_dtype``). Set compute dtype
+to float32 (the default) for Caffe-parity numerics; matmul precision is then
+forced to HIGHEST (see ``matmul_precision``).
 """
 
 from __future__ import annotations

@@ -13,8 +13,9 @@ blocks arriving one at a time, maintain (acc, m, l) with
     l'   = l * exp(m - m') + rowsum(p)
     acc' = acc * exp(m - m') + p @ V
 
-and finalize with acc / l. All matmuls run in the global compute policy
-(bfloat16 on MXU with f32 accumulation).
+and finalize with acc / l. Matmul inputs run in the global compute policy
+(bfloat16 feeds the MXU, which accumulates in f32 internally); softmax
+statistics and the block accumulators are always float32.
 """
 
 from __future__ import annotations
@@ -41,6 +42,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         q.astype(p.compute_dtype), k.astype(p.compute_dtype),
         (((3,), (3,)), ((0, 1), (0, 1))),
         precision=matmul_precision()) * scale
+    s = s.astype(jnp.float32)  # softmax statistics always accumulate in f32
     if bias is not None:
         s = s + bias
     if causal:

@@ -209,5 +209,5 @@ def inner_product(x: jax.Array, w: jax.Array, b: Optional[jax.Array]) -> jax.Arr
         precision=matmul_precision(),
     )
     if b is not None:
-        y = y + b.astype(y.dtype)
+        y = y + b.astype(y.dtype)  # match conv2d/SFB: stay in compute dtype
     return y

@@ -1,0 +1,201 @@
+"""Pallas TPU kernels for the hot ops.
+
+``flash_attention``: blockwise attention entirely in VMEM — never
+materializes the (S, S) score matrix in HBM. Grid is (batch*heads,
+query-blocks); each program streams key/value blocks through the
+online-softmax recurrence (the same math as ops/attention.py's BlockAcc, here
+per 128-row tile). The backward pass currently recomputes through the
+reference attention's VJP (correct, O(S^2) memory in HBM); a Pallas backward
+is future work.
+
+``lrn_fused``: cross-channel LRN forward in one VMEM pass — x^2, the
+channel-window running sum, pow, and the product fused per (H*W)-tile, saving
+the intermediate HBM round-trips of the unfused op on pre-fusion XLA.
+
+Kernels run in interpret mode off-TPU so the CPU test mesh exercises the same
+code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF, attention
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+# --------------------------------------------------------------------------- #
+# Flash attention
+# --------------------------------------------------------------------------- #
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                      scale: float, causal: bool, block_q: int, block_k: int,
+                      n_kb: int):
+    """Grid (bh, q_blocks, k_blocks); only one (block_q, d) Q tile and one
+    (block_k, d) K/V tile are VMEM-resident at a time. The online-softmax
+    state persists in scratch across the innermost (k-block) grid dimension."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: blocks entirely above the diagonal contribute nothing
+    block_live = True if not causal else (kj * block_k
+                                          <= qi * block_q + block_q - 1)
+
+    @pl.when(block_live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)         # (block_q, d)
+        k_blk = k_ref[0].astype(jnp.float32)     # (block_k, d)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+
+    @pl.when(kj == n_kb - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
+               block_k: int, interpret: bool):
+    b, h, s, d = q.shape
+    bh = b * h
+    q3 = q.reshape(bh, s, d)
+    k3 = k.reshape(bh, s, d)
+    v3 = v.reshape(bh, s, d)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must divide by blocks "
+                         f"({block_q}, {block_k})")
+    n_kb = s // block_k
+    grid = (bh, s // block_q, n_kb)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_kb=n_kb),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, s, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Pallas blockwise attention; (B, H, S, D) -> (B, H, S, D)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention(q_, k_, v_, causal=causal, scale=scale),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# --------------------------------------------------------------------------- #
+# Fused cross-channel LRN
+# --------------------------------------------------------------------------- #
+
+def _lrn_kernel(x_ref, o_ref, *, local_size: int, alpha: float, beta: float,
+                k: float, channels: int):
+    x = x_ref[0].astype(jnp.float32)  # (C, T) — channels x spatial tile
+    pre = (local_size - 1) // 2
+    sq = x * x
+    padded = jnp.pad(sq, ((pre, local_size - pre - 1), (0, 0)))
+    windowed = jnp.zeros_like(sq)
+    for dc in range(local_size):
+        windowed = windowed + lax.slice_in_dim(padded, dc, dc + channels,
+                                               axis=0)
+    scale = k + (alpha / local_size) * windowed
+    o_ref[0] = (x * scale ** (-beta)).astype(o_ref.dtype)
+
+
+def lrn_fused(x, local_size: int, alpha: float, beta: float, k: float = 1.0,
+              tile: int = 512, interpret: Optional[bool] = None):
+    """Fused LRN forward: x (N, C, H, W). Differentiable via recompute VJP."""
+    if interpret is None:
+        interpret = _interpret_default()
+    n, c, h, w = x.shape
+    hw = h * w
+    tile = min(tile, hw)
+    if hw % tile:
+        tile = hw  # fall back to one tile per image
+    x2 = x.reshape(n, c, hw)
+    out = pl.pallas_call(
+        functools.partial(_lrn_kernel, local_size=local_size, alpha=alpha,
+                          beta=beta, k=k, channels=c),
+        out_shape=jax.ShapeDtypeStruct((n, c, hw), x.dtype),
+        grid=(n, hw // tile),
+        in_specs=[pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2)
+    return out.reshape(n, c, h, w)

@@ -114,9 +114,12 @@ def _sfb_matmul(axis: str, reduce: str, with_bias: bool):
         x2, w = res
         p = policy()
         # local input gradient — never leaves the chip
+        # custom_vjp bwd is never differentiated through, so forcing f32
+        # accumulation here is autodiff-safe (unlike the forward ops).
         gx = lax.dot_general(
             g.astype(p.compute_dtype), w.astype(p.compute_dtype),
             (((1,), (0,)), ((), ())),
+            preferred_element_type=p.accum_dtype,
             precision=matmul_precision()).astype(x2.dtype)
         # sufficient factors: a = top diff (B, M), b = bottom data (B, K)
         G = lax.all_gather(g, axis, tiled=True)       # (B_global, M)
@@ -124,7 +127,8 @@ def _sfb_matmul(axis: str, reduce: str, with_bias: bool):
         gw = lax.dot_general(
             G.astype(p.compute_dtype), X.astype(p.compute_dtype),
             (((0,), (0,)), ((), ())),
-            precision=matmul_precision())     # (M, K) — global sum
+            preferred_element_type=p.accum_dtype,
+            precision=matmul_precision())     # (M, K) — global f32 sum
         gw = _maybe_mean(gw, axis, reduce).astype(w.dtype)
         if with_bias:
             gb = _maybe_mean(lax.psum(jnp.sum(g, axis=0), axis), axis, reduce)
