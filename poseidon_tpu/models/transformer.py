@@ -1,0 +1,161 @@
+"""Transformer LM family — the long-context flagship (beyond the reference).
+
+The reference is a 2015 CNN framework; this model family exists because
+long-context and distributed are first-class here. A GPT-style decoder built
+from the framework's own pieces: ``ops/attention.py`` (or the Pallas flash
+kernel) for compute, ``parallel/sequence.py`` for sequence parallelism, the
+Caffe-exact solvers for updates. Parameters are a plain pytree like Net's, so
+checkpoints/metrics reuse the runtime unchanged.
+
+``build_dp_sp_train_step`` shards batch over the "data" axis and sequence
+over the "seq" axis of one 2-D mesh: gradients psum over BOTH axes (every
+device holds a full replica of the params), activations of the attention ring
+rotate along "seq" only.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import matmul_precision, policy
+from ..ops.attention import attention
+from ..parallel.sequence import ring_attention
+from ..proto.messages import SolverParameter
+from ..solvers.updates import SolverState, init_state, make_update_fn
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 1024
+
+
+def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict:
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in)))
+
+    keys = jax.random.split(rng, 4 + 6 * cfg.n_layers)
+    params: Dict = {
+        "embed": {"w": dense(keys[0], 1, (cfg.vocab_size, cfg.d_model)) * 0.02},
+        "pos": {"w": dense(keys[1], 1, (cfg.max_seq, cfg.d_model)) * 0.02},
+        "head": {"w": dense(keys[2], cfg.d_model,
+                            (cfg.vocab_size, cfg.d_model))},
+        "ln_f": {"g": jnp.ones((cfg.d_model,)), "b": jnp.zeros((cfg.d_model,))},
+    }
+    for i in range(cfg.n_layers):
+        k = keys[4 + 6 * i:4 + 6 * (i + 1)]
+        params[f"block{i}"] = {
+            "wqkv": dense(k[0], cfg.d_model, (3 * cfg.d_model, cfg.d_model)),
+            "wo": dense(k[1], cfg.d_model, (cfg.d_model, cfg.d_model)),
+            "w1": dense(k[2], cfg.d_model, (cfg.d_ff, cfg.d_model)),
+            "w2": dense(k[3], cfg.d_ff, (cfg.d_model, cfg.d_ff)),
+            "ln1_g": jnp.ones((cfg.d_model,)),
+            "ln1_b": jnp.zeros((cfg.d_model,)),
+            "ln2_g": jnp.ones((cfg.d_model,)),
+            "ln2_b": jnp.zeros((cfg.d_model,)),
+        }
+    return params
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+
+def _dense(x, w):
+    p = policy()
+    return lax.dot_general(
+        x.astype(p.compute_dtype), w.astype(p.compute_dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        precision=matmul_precision())
+
+
+def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
+            *, seq_axis: Optional[str] = None,
+            pos_offset: jax.Array | int = 0) -> jax.Array:
+    """tokens (B, S_local) -> logits (B, S_local, V). With ``seq_axis``,
+    attention runs as a ring over that mesh axis; everything else is local."""
+    b, s = tokens.shape
+    x = params["embed"]["w"][tokens]
+    positions = pos_offset + jnp.arange(s)
+    x = x + params["pos"]["w"][positions]
+    for i in range(len([k for k in params if k.startswith("block")])):
+        blk = params[f"block{i}"]
+        h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
+        qkv = _dense(h, blk["wqkv"])  # (B, S, 3*D)
+        d_head = cfg.d_model // cfg.n_heads
+        qkv = qkv.reshape(b, s, 3, cfg.n_heads, d_head)
+        q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))  # (B,H,S,Dh)
+        if seq_axis is None:
+            att = attention(q, k, v, causal=True)
+        else:
+            att = ring_attention(q, k, v, seq_axis, causal=True)
+        att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)
+        x = x + _dense(att, blk["wo"]).astype(x.dtype)
+        h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+        ff = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
+        x = x + ff.astype(x.dtype)
+    x = _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return _dense(x, params["head"]["w"]).astype(jnp.float32)
+
+
+def lm_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def transformer_mults(params) -> Dict:
+    return {lname: {p: (1.0, 1.0 if p.startswith("w") else 0.0)
+                    for p in lp}
+            for lname, lp in params.items()}
+
+
+def build_dp_sp_train_step(cfg: TransformerConfig, sp: SolverParameter,
+                           mesh: Mesh, data_axis: str = "data",
+                           seq_axis: str = "seq", donate: bool = True):
+    """Training step over a 2-D (data x seq) mesh.
+
+    tokens/targets come in (B_global, S_global); each device sees
+    (B/data, S/seq). The causal shift happens host-side (targets =
+    tokens[:, 1:]); gradients psum over both axes; params stay replicated.
+    """
+    def device_step(params, state: SolverState, tokens, targets, rng):
+        seq_ix = lax.axis_index(seq_axis)
+        s_local = tokens.shape[1]
+
+        def loss_fn(p):
+            logits = forward(p, cfg, tokens, seq_axis=seq_axis,
+                             pos_offset=seq_ix * s_local)
+            return lm_loss(logits, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(lax.pmean(g, data_axis), seq_axis), grads)
+        upd = make_update_fn(sp, transformer_mults(params))
+        new_params, new_state = upd(params, grads, state)
+        metrics = {"loss": lax.pmean(lax.pmean(loss, data_axis), seq_axis)}
+        return new_params, new_state, metrics
+
+    sharded = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(P(), P(), P(data_axis, seq_axis), P(data_axis, seq_axis),
+                  P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
