@@ -61,6 +61,11 @@ class CommConfig:
     # retuned lr per cluster size; select it only for strict reference parity.
     reduce: str = "mean"
     topk_fraction: float = 0.01
+    # Optional bandwidth budget for the managed-comm (TOPK) tier, in MB per
+    # step per device — the SSPAggr "client_bandwidth_mbps" analog
+    # (trans_time_estimate.hpp). When set, topk_fraction is derived from the
+    # budget over the TOPK layers' total parameter count.
+    bandwidth_budget_mb: Optional[float] = None
 
     def strategy_for(self, layer: str) -> str:
         return self.layer_strategies.get(layer, self.default_strategy)
@@ -179,6 +184,20 @@ class CommContext:
             return _sfb_matmul(self.cfg.axis, self.cfg.reduce, True)(x2, w, b)
         return _sfb_matmul(self.cfg.axis, self.cfg.reduce, False)(
             x2, w, jnp.zeros((w.shape[0],), w.dtype))
+
+
+def budget_topk_fraction(net, cfg: CommConfig) -> float:
+    """Derive the top-k fraction from a per-step bandwidth budget: each sent
+    entry costs ~8 bytes (index + value); spread the budget across all TOPK
+    layers' parameters."""
+    if cfg.bandwidth_budget_mb is None:
+        return cfg.topk_fraction
+    total = sum(p.count for lname, defs in net.param_defs.items()
+                for p in defs if cfg.strategy_for(lname) == TOPK)
+    if total == 0:
+        return cfg.topk_fraction
+    entries = cfg.bandwidth_budget_mb * 1e6 / 8.0
+    return float(min(1.0, max(entries / total, 1e-5)))
 
 
 def auto_strategies(net, min_sfb_rank_saving: float = 2.0) -> Dict[str, str]:

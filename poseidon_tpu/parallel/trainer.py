@@ -27,7 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.net import Net
 from ..proto.messages import SolverParameter
 from ..solvers.updates import SolverState, init_state, make_update_fn
-from .strategies import CommConfig, CommContext, LOCAL, TOPK, topk_compress
+from .strategies import (CommConfig, CommContext, LOCAL, TOPK,
+                         budget_topk_fraction, topk_compress)
 
 
 def param_mults(net: Net) -> Dict[str, Dict[str, tuple]]:
@@ -94,6 +95,7 @@ def build_train_step(
 
     topk_layers = [l for l in net.param_defs
                    if comm.strategy_for(l) == TOPK]
+    topk_fraction = budget_topk_fraction(net, comm)
 
     def device_step(params, state: TrainState, batch, rng):
         rng = jax.random.fold_in(rng, lax.axis_index(axis))
@@ -111,7 +113,7 @@ def build_train_step(
             lerr = {}
             for pname, g in grads[lname].items():
                 err = state.comm_error[lname][pname][0]  # unstack device dim
-                sent, resid = topk_compress(g, comm.topk_fraction, err)
+                sent, resid = topk_compress(g, topk_fraction, err)
                 g_sync = lax.psum(sent, axis)
                 if comm.reduce == "mean":
                     g_sync = g_sync / n_dev

@@ -153,6 +153,37 @@ def cmd_time(args) -> int:
     jax.block_until_ready(jax.tree_util.tree_leaves(g)[0])
     fb_ms = (_time.perf_counter() - t0) / args.iterations * 1e3
 
+    # Per-layer forward timing (the reference's per-layer breakdown,
+    # caffe_main.cpp:256-328). Layers are timed in isolation, so totals can
+    # differ from the fused whole-graph time — that fusion gap is itself
+    # useful signal.
+    if args.per_layer:
+        from ..core.layers import ApplyCtx
+        print(f"{'layer':<24}{'type':<22}{'fwd ms':>10}")
+        for layer in net.layers:
+            bottoms = [jnp.zeros(net.blob_shapes[bname], jnp.float32)
+                       for bname in layer.lp.bottom]
+            lp_params = {pd.name: params[layer.name][pd.name]
+                         for pd in layer.params} if layer.params else {}
+
+            def run(ps, bs, _l=layer):
+                ctx = ApplyCtx(train=True, rng=jax.random.PRNGKey(0))
+                return _l.apply(ps, bs, ctx)
+
+            try:
+                jitted = jax.jit(run)
+                jax.block_until_ready(jitted(lp_params, bottoms))
+                t0 = _time.perf_counter()
+                for _ in range(args.iterations):
+                    out = jitted(lp_params, bottoms)
+                jax.block_until_ready(jax.tree_util.tree_leaves(out)[0]
+                                      if jax.tree_util.tree_leaves(out)
+                                      else jnp.zeros(()))
+                ms = (_time.perf_counter() - t0) / args.iterations * 1e3
+                print(f"{layer.name:<24}{layer.TYPE:<22}{ms:>10.3f}")
+            except Exception as e:  # e.g. int-labeled losses fed zeros
+                print(f"{layer.name:<24}{layer.TYPE:<22}{'skip':>10} ({e})")
+
     print(f"Average Forward pass: {fwd_ms:.3f} ms")
     print(f"Average Forward-Backward: {fb_ms:.3f} ms")
     print(f"Throughput: {batch / (fb_ms / 1e3):.1f} images/s "
@@ -238,6 +269,8 @@ def build_parser() -> argparse.ArgumentParser:
     ti.add_argument("--model", required=True)
     ti.add_argument("--iterations", type=int, default=50)
     ti.add_argument("--batch_size", type=int, default=64)
+    ti.add_argument("--per_layer", action="store_true",
+                    help="also print per-layer forward times")
     ti.set_defaults(fn=cmd_time)
 
     dq = sub.add_parser("device_query", help="show accelerator info")

@@ -160,3 +160,13 @@ def test_ssp_bounded_staleness(mesh, lenet_net, rng_np):
             # replicas allowed to drift between syncs
             assert np.isfinite(local).all()
     assert np.isfinite(float(m["loss"]))
+
+
+def test_bandwidth_budget_derives_topk_fraction(lenet_net):
+    from poseidon_tpu.parallel.strategies import budget_topk_fraction
+    cc = CommConfig(default_strategy="topk", bandwidth_budget_mb=0.1)
+    frac = budget_topk_fraction(lenet_net, cc)
+    total = lenet_net.param_count()
+    assert frac == pytest.approx(0.1e6 / 8.0 / total, rel=1e-6)
+    # no budget -> configured fraction
+    assert budget_topk_fraction(lenet_net, CommConfig()) == 0.01
