@@ -60,11 +60,11 @@ def build_source(lp: LayerParameter, shard: Shard,
                                    dp.shared_file_system)
         if dp.backend == "LMDB":
             return LMDBSource(path)
-        # Try LMDB layout anyway (a converted DB may sit at the same path)
+        # LEVELDB (the default). Tolerate a converted LMDB at the same path.
         try:
-            return LMDBSource(path)
-        except Exception:
             return LevelDBSource(path)
+        except Exception:
+            return LMDBSource(path)
     if t == "IMAGE_DATA":
         ip = lp.image_data_param
         path = sharded_source_path(ip.source, shard.index,

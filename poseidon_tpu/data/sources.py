@@ -2,7 +2,7 @@
 
 Each source yields (image_array, label) records; batching, augmentation and
 device transfer are layered on top (pipeline.py). Backends mirror the layer
-catalog: DATA (LMDB via our reader; LevelDB pending), IMAGE_DATA (file lists +
+catalog: DATA (LMDB and LevelDB via our readers), IMAGE_DATA (file lists +
 PIL/cv2 decode), HDF5_DATA, MEMORY_DATA, plus synthetic sources for
 benchmarks. Reference: ``src/caffe/layers/{data,image_data,hdf5_data,
 memory_data}_layer.cpp`` and ``include/caffe/data_layers.hpp:73-122``.
@@ -48,10 +48,19 @@ class LMDBSource(Source):
 
 
 class LevelDBSource(Source):
+    """DATA backend LEVELDB (the caffe.proto default), via the pure-Python
+    SSTable/log/manifest reader in leveldb_reader.py."""
+
     def __init__(self, path: str):
-        raise NotImplementedError(
-            "LevelDB reading requires the SSTable reader (planned); convert "
-            "the database to LMDB with tools/convert_db or use backend: LMDB")
+        from .leveldb_reader import LevelDBReader
+        self.db = LevelDBReader(path)
+
+    def __len__(self) -> int:
+        return len(self.db)
+
+    def read(self, index: int) -> Tuple[np.ndarray, int]:
+        d = decode_datum(self.db.value_at(index))
+        return d.to_array(), d.label
 
 
 class ImageListSource(Source):

@@ -1,0 +1,123 @@
+"""LevelDB format reader/writer + snappy codec."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.data import snappy
+from poseidon_tpu.data.leveldb_reader import (
+    LOG_FULL, LevelDBReader, LevelDBWriter, TYPE_DELETION, TYPE_VALUE,
+    crc32c, crc32c_masked, read_log)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vector: 32 bytes of zeros -> 0x8a9136aa
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+def test_snappy_roundtrip_literals():
+    rs = np.random.RandomState(0)
+    for n in [0, 1, 59, 60, 61, 300, 70000]:
+        data = rs.bytes(n)
+        assert snappy.uncompress(snappy.compress(data)) == data
+
+
+def test_snappy_copy_elements():
+    # hand-crafted: literal "abcd" then copy-1 (len 4 -> (4-4)=0 in bits 2..4,
+    # offset 4) -> "abcdabcd"
+    blob = bytes([8]) + bytes([3 << 2]) + b"abcd" + bytes([1, 4])
+    assert snappy.uncompress(blob) == b"abcdabcd"
+    # overlapping copy: literal "ab", copy-1 len 6 ((6-4)=2) offset 2
+    blob2 = bytes([8]) + bytes([1 << 2]) + b"ab" + bytes([(2 << 2) | 1, 2])
+    assert snappy.uncompress(blob2) == b"abababab"
+
+
+@pytest.mark.parametrize("compress", [False, True])
+def test_leveldb_write_read_roundtrip(tmp_path, compress):
+    path = str(tmp_path / "db")
+    w = LevelDBWriter(path, compress=compress)
+    rs = np.random.RandomState(0)
+    values = {}
+    for i in range(500):  # multiple blocks
+        key = f"{i:08d}".encode()
+        val = rs.bytes(rs.randint(20, 400))
+        values[key] = val
+        w.put(key, val)
+    w.close()
+
+    r = LevelDBReader(path)
+    assert len(r) == 500
+    got = dict(iter(r))
+    assert got == values
+    assert [r.key_at(i) for i in range(3)] == sorted(values)[:3]
+    assert r.value_at(0) == values[sorted(values)[0]]
+
+
+def test_leveldb_log_replay_and_deletions(tmp_path):
+    """A log-only database (never compacted): entries live in the WAL."""
+    path = tmp_path / "db"
+    path.mkdir()
+    # WriteBatch: seq=1, count=3: put a=1, put b=2, delete a
+    batch = bytearray()
+    batch += struct.pack("<Q", 1) + struct.pack("<I", 3)
+    for op, key, val in [(TYPE_VALUE, b"a", b"1"), (TYPE_VALUE, b"b", b"2"),
+                         (TYPE_DELETION, b"a", None)]:
+        batch.append(op)
+        batch.append(len(key))
+        batch += key
+        if val is not None:
+            batch.append(len(val))
+            batch += val
+    payload = bytes(batch)
+    header = struct.pack("<IHB", crc32c_masked(bytes([LOG_FULL]) + payload),
+                         len(payload), LOG_FULL)
+    (path / "000003.log").write_bytes(header + payload)
+
+    r = LevelDBReader(str(path))
+    assert len(r) == 1
+    assert dict(iter(r)) == {b"b": b"2"}
+
+
+def test_leveldb_datum_source(tmp_path):
+    from poseidon_tpu.data.leveldb_reader import LevelDBWriter
+    from poseidon_tpu.data.sources import LevelDBSource
+    from poseidon_tpu.proto.wire import Datum, encode_datum
+
+    path = str(tmp_path / "db")
+    w = LevelDBWriter(path)
+    rs = np.random.RandomState(1)
+    for i in range(12):
+        arr = rs.randint(0, 255, size=(3, 5, 5)).astype(np.uint8)
+        w.put(f"{i:08d}".encode(),
+              encode_datum(Datum(3, 5, 5, arr.tobytes(), label=i)))
+    w.close()
+    src = LevelDBSource(path)
+    assert len(src) == 12
+    arr, label = src.read(7)
+    assert arr.shape == (3, 5, 5) and label == 7
+
+
+def test_data_layer_leveldb_backend(tmp_path):
+    from poseidon_tpu.data.leveldb_reader import LevelDBWriter
+    from poseidon_tpu.data.pipeline import BatchPipeline
+    from poseidon_tpu.proto.messages import DataParameter, LayerParameter
+    from poseidon_tpu.proto.wire import Datum, encode_datum
+
+    path = str(tmp_path / "db")
+    w = LevelDBWriter(path)
+    rs = np.random.RandomState(2)
+    for i in range(20):
+        arr = rs.randint(0, 255, size=(1, 6, 6)).astype(np.uint8)
+        w.put(f"{i:08d}".encode(),
+              encode_datum(Datum(1, 6, 6, arr.tobytes(), label=i % 4)))
+    w.close()
+    lp = LayerParameter(
+        name="d", type="DATA", top=["data", "label"],
+        data_param=DataParameter(source=path, batch_size=5))  # default backend
+    pipe = BatchPipeline(lp, "TRAIN", 5)
+    b = next(pipe)
+    assert b["data"].shape == (5, 1, 6, 6)
+    pipe.close()
