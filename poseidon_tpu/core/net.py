@@ -132,9 +132,69 @@ class Net:
                     produced.append(t)
         self.output_names = [t for t in produced if t not in consumed]
 
-        self.param_defs: Dict[str, List[ParamDef]] = {
-            layer.name: layer.params for layer in self.layers if layer.params}
+        # Cross-layer weight sharing (the reference's named params,
+        # layer.hpp / net.cpp shared-blob machinery; what siamese nets use):
+        # a non-empty ParamSpec.name binds a layer's blob to shared storage
+        # owned by the first layer that declared the name. param_defs holds
+        # OWNERS only, so the gradient pytree has one leaf per unique
+        # parameter and autodiff sums the contributions of every sharer.
+        self.param_defs: Dict[str, List[ParamDef]] = {}
+        self._storage_of: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        shared_owner: Dict[str, Tuple[str, str, ParamDef]] = {}
+        for layer in self.layers:
+            if not layer.params:
+                continue
+            owned: List[ParamDef] = []
+            for i, pdef in enumerate(layer.params):
+                share_name = layer.lp.param_spec(i).name
+                if share_name and share_name in shared_owner:
+                    olayer, opname, odef = shared_owner[share_name]
+                    spec = layer.lp.param_spec(i)
+                    # V1 nets use the layer-level blob_share_mode list; V2
+                    # nets carry share_mode on the ParamSpec itself.
+                    mode = (layer.lp.blob_share_mode[i]
+                            if i < len(layer.lp.blob_share_mode)
+                            else spec.share_mode)
+                    if (spec.lr_mult, spec.decay_mult) != (odef.lr_mult,
+                                                           odef.decay_mult):
+                        raise ValueError(
+                            f"layer {layer.name!r}: shared param "
+                            f"{share_name!r} lr/decay multipliers "
+                            f"({spec.lr_mult}, {spec.decay_mult}) differ from "
+                            f"owner {olayer!r}'s ({odef.lr_mult}, "
+                            f"{odef.decay_mult})")
+                    if mode == "PERMISSIVE":
+                        if pdef.count != odef.count:
+                            raise ValueError(
+                                f"layer {layer.name!r}: shared param "
+                                f"{share_name!r} count mismatch "
+                                f"{pdef.count} vs {odef.count}")
+                    elif pdef.shape != odef.shape:
+                        raise ValueError(
+                            f"layer {layer.name!r}: shared param "
+                            f"{share_name!r} shape mismatch "
+                            f"{pdef.shape} vs {odef.shape}")
+                    self._storage_of[(layer.name, pdef.name)] = (olayer, opname)
+                else:
+                    if share_name:
+                        shared_owner[share_name] = (layer.name, pdef.name, pdef)
+                    self._storage_of[(layer.name, pdef.name)] = (layer.name,
+                                                                 pdef.name)
+                    owned.append(pdef)
+            if owned:
+                self.param_defs[layer.name] = owned
         self._layer_by_name = {l.name: l for l in self.layers}
+
+    def _layer_params(self, params, layer: Layer) -> Dict[str, jax.Array]:
+        """Resolve a layer's param dict through the sharing bindings."""
+        out = {}
+        for pdef in layer.params:
+            olayer, opname = self._storage_of[(layer.name, pdef.name)]
+            arr = params[olayer][opname]
+            if arr.shape != pdef.shape:  # PERMISSIVE share: same count
+                arr = arr.reshape(pdef.shape)
+            out[pdef.name] = arr
+        return out
 
     # ------------------------------------------------------------------ #
     def init(self, rng: jax.Array) -> Dict[str, Dict[str, jax.Array]]:
@@ -169,7 +229,9 @@ class Net:
         for layer in self.layers:
             lp = layer.lp
             bottoms = [blobs[b] for b in lp.bottom]
-            tops = layer.apply(params.get(layer.name, {}), bottoms, ctx)
+            tops = layer.apply(
+                self._layer_params(params, layer) if layer.params else {},
+                bottoms, ctx)
             weights = layer.loss_weights(len(tops))
             for name, val, w in zip(lp.top, tops, weights):
                 blobs[name] = val
@@ -189,11 +251,14 @@ class Net:
         name/order; unknown layers ignored unless strict."""
         new_params = {k: dict(v) for k, v in params.items()}
         for lname, arrays in layer_weights.items():
-            if lname not in self.param_defs:
+            layer = self._layer_by_name.get(lname)
+            if layer is None or not layer.params:
                 if strict:
                     raise KeyError(f"no such param layer {lname!r}")
                 continue
-            defs = self.param_defs[lname]
+            # Caffe serializes EVERY layer's blobs, shared ones included
+            # (Layer::ToProto); route each blob to its owning storage.
+            defs = layer.params
             if len(arrays) != len(defs):
                 raise ValueError(
                     f"{lname}: {len(arrays)} blobs in file, {len(defs)} in net")
@@ -203,12 +268,19 @@ class Net:
                     raise ValueError(
                         f"{lname}/{pdef.name}: count mismatch "
                         f"{arr.size} vs {pdef.count}")
-                new_params[lname][pdef.name] = jnp.asarray(
-                    arr.reshape(pdef.shape))
+                olayer, opname = self._storage_of[(lname, pdef.name)]
+                oshape = next(d.shape for d in self.param_defs[olayer]
+                              if d.name == opname)
+                new_params[olayer][opname] = jnp.asarray(arr.reshape(oshape))
         return new_params
 
     def export_weights(self, params) -> Dict[str, List[np.ndarray]]:
-        return {
-            lname: [np.asarray(params[lname][p.name]) for p in defs]
-            for lname, defs in self.param_defs.items()
-        }
+        """Every param layer's blobs, shared ones included (Caffe's
+        serialization shape: sharers repeat the shared array)."""
+        out: Dict[str, List[np.ndarray]] = {}
+        for layer in self.layers:
+            if layer.params:
+                out[layer.name] = [
+                    np.asarray(self._layer_params(params, layer)[p.name])
+                    for p in layer.params]
+        return out

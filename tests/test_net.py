@@ -125,3 +125,59 @@ def test_caffemodel_wire_roundtrip(rng_np, tmp_path):
     np.testing.assert_allclose(
         decoded["conv1"][0].reshape(20, 1, 5, 5),
         np.asarray(params["conv1"]["w"]), rtol=1e-6)
+
+
+def test_shared_weights_siamese():
+    """Caffe's named-param sharing (siamese pattern): two branches share conv
+    weights via `param:` names; gradients flow through both uses."""
+    from poseidon_tpu.proto.messages import load_net_from_string
+    net_param = load_net_from_string("""
+    name: "siamese"
+    layers { name: "ip_a" type: INNER_PRODUCT bottom: "xa" top: "fa"
+      param: "shared_w" param: "shared_b"
+      inner_product_param { num_output: 6 weight_filler { type: "xavier" } } }
+    layers { name: "ip_b" type: INNER_PRODUCT bottom: "xb" top: "fb"
+      param: "shared_w" param: "shared_b"
+      inner_product_param { num_output: 6 weight_filler { type: "xavier" } } }
+    layers { name: "loss" type: CONTRASTIVE_LOSS
+      bottom: "fa" bottom: "fb" bottom: "sim" top: "loss"
+      contrastive_loss_param { margin: 1.0 } }
+    """)
+    shapes = {"xa": (4, 3), "xb": (4, 3), "sim": (4,)}
+    net = Net(net_param, "TRAIN", source_shapes=shapes)
+    # only the owner layer holds storage
+    assert "ip_a" in net.param_defs and "ip_b" not in net.param_defs
+    params = net.init(jax.random.PRNGKey(0))
+    assert set(params) == {"ip_a"}
+
+    rs = np.random.RandomState(0)
+    batch = {"xa": jnp.asarray(rs.randn(4, 3).astype(np.float32)),
+             "xb": jnp.asarray(rs.randn(4, 3).astype(np.float32)),
+             "sim": jnp.asarray(np.array([1, 0, 1, 0], np.float32))}
+    out = net.apply(params, batch, keep_blobs=True)
+    # both branches used the same weights
+    w = np.asarray(params["ip_a"]["w"])
+    np.testing.assert_allclose(
+        np.asarray(out.blobs["fb"]),
+        np.asarray(batch["xb"]) @ w.T + np.asarray(params["ip_a"]["b"]),
+        rtol=1e-5)
+
+    # gradient accumulates from BOTH branches: zeroing one branch's input
+    # changes the shared-weight gradient
+    def loss_fn(p, b):
+        return net.apply(p, b).loss
+
+    g_both = jax.grad(loss_fn)(params, batch)
+    batch_zero_b = dict(batch, xb=jnp.zeros_like(batch["xb"]))
+    g_one = jax.grad(loss_fn)(params, batch_zero_b)
+    assert np.abs(np.asarray(g_both["ip_a"]["w"])).sum() > 0
+    assert not np.allclose(np.asarray(g_both["ip_a"]["w"]),
+                           np.asarray(g_one["ip_a"]["w"]))
+
+    # round trip: caffemodel export contains BOTH layers' blobs (Caffe's
+    # serialization), and loading routes sharer blobs back to owner storage
+    exported = net.export_weights(params)
+    assert set(exported) == {"ip_a", "ip_b"}
+    np.testing.assert_array_equal(exported["ip_a"][0], exported["ip_b"][0])
+    reloaded = net.load_weights(net.init(jax.random.PRNGKey(9)), exported)
+    np.testing.assert_array_equal(np.asarray(reloaded["ip_a"]["w"]), w)
