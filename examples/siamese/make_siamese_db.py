@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Synthetic siamese-pair LMDBs: 2-channel datums (left/right), sim label."""
+import os, sys
+import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "../.."))
+from poseidon_tpu.data.lmdb_reader import LMDBWriter
+from poseidon_tpu.proto.wire import Datum, encode_datum
+
+def build(path, n, seed):
+    rs = np.random.RandomState(seed)
+    templates = rs.randint(60, 196, size=(10, 28, 28))
+    w = LMDBWriter(path)
+    for i in range(n):
+        a = int(rs.randint(10))
+        sim = int(rs.randint(2))
+        b = a if sim else int((a + 1 + rs.randint(9)) % 10)
+        pair = np.stack([
+            np.clip(templates[a] + rs.normal(0, 30, (28, 28)), 0, 255),
+            np.clip(templates[b] + rs.normal(0, 30, (28, 28)), 0, 255),
+        ]).astype(np.uint8)
+        w.put(f"{i:08d}".encode(),
+              encode_datum(Datum(2, 28, 28, pair.tobytes(), label=sim)))
+    w.close()
+    print(f"wrote {n} pairs -> {path}")
+
+if __name__ == "__main__":
+    base = os.path.dirname(os.path.abspath(__file__))
+    build(os.path.join(base, "mnist_siamese_train_lmdb"), 2000, 0)
+    build(os.path.join(base, "mnist_siamese_test_lmdb"), 400, 1)

@@ -212,6 +212,12 @@ def cmd_partition_data(args) -> int:
     return 0
 
 
+def cmd_convert_db(args) -> int:
+    from .tools import convert_db
+    convert_db(args.src, args.out, args.backend)
+    return 0
+
+
 def cmd_extract_features(args) -> int:
     import jax
     from ..core.net import Net
@@ -295,6 +301,12 @@ def build_parser() -> argparse.ArgumentParser:
     pd.add_argument("db")
     pd.add_argument("num_shards", type=int)
     pd.set_defaults(fn=cmd_partition_data)
+
+    cd = sub.add_parser("convert_db", help="copy LevelDB<->LMDB")
+    cd.add_argument("src")
+    cd.add_argument("out")
+    cd.add_argument("--backend", default="LMDB", choices=["LMDB", "LEVELDB"])
+    cd.set_defaults(fn=cmd_convert_db)
 
     ef = sub.add_parser("extract_features",
                         help="dump named blobs to LMDBs")

@@ -100,6 +100,27 @@ def partition_data(db_path: str, num_shards: int) -> List[str]:
     return out_paths
 
 
+def convert_db(src_path: str, out_path: str, out_backend: str = "LMDB") -> int:
+    """Copy a database between backends (LevelDB <-> LMDB). LMDB output gets
+    the native C++ ingest fast path."""
+    from ..data.lmdb_reader import LMDBReader, LMDBWriter
+    from ..data.leveldb_reader import LevelDBReader, LevelDBWriter
+
+    reader = None
+    try:
+        reader = LMDBReader(src_path)
+    except Exception:
+        reader = LevelDBReader(src_path)
+    writer = LMDBWriter(out_path) if out_backend.upper() == "LMDB"         else LevelDBWriter(out_path)
+    n = 0
+    for key, value in reader:
+        writer.put(key, value)
+        n += 1
+    writer.close()
+    log(f"convert_db: {n} records -> {out_path} ({out_backend})")
+    return n
+
+
 def extract_features(net, params, blob_names: List[str], pipeline,
                      num_batches: int, out_prefix: str,
                      mesh=None) -> List[str]:

@@ -121,3 +121,28 @@ def test_data_layer_leveldb_backend(tmp_path):
     b = next(pipe)
     assert b["data"].shape == (5, 1, 6, 6)
     pipe.close()
+
+
+def test_convert_db_roundtrip(tmp_path):
+    from poseidon_tpu.data.leveldb_reader import LevelDBWriter
+    from poseidon_tpu.data.lmdb_reader import LMDBReader
+    from poseidon_tpu.runtime.tools import convert_db
+
+    src = str(tmp_path / "ldb")
+    w = LevelDBWriter(src)
+    for i in range(10):
+        w.put(f"{i:04d}".encode(), f"value{i}".encode())
+    w.close()
+
+    out = str(tmp_path / "mdb")
+    assert convert_db(src, out, "LMDB") == 10
+    r = LMDBReader(out)
+    assert len(r) == 10
+    assert r.value_at(3) == b"value3"
+
+    back = str(tmp_path / "ldb2")
+    assert convert_db(out, back, "LEVELDB") == 10
+    from poseidon_tpu.data.leveldb_reader import LevelDBReader
+    r2 = LevelDBReader(back)
+    assert dict(iter(r2)) == {f"{i:04d}".encode(): f"value{i}".encode()
+                              for i in range(10)}
