@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.net import Net
 from ..proto.messages import SolverParameter
 from ..solvers.updates import SolverState, init_state, make_update_fn
-from .strategies import (CommConfig, CommContext, LOCAL, TOPK,
+from .strategies import (CommConfig, CommContext, LOCAL, SFB, TOPK,
                          budget_topk_fraction, topk_compress)
 
 
@@ -49,18 +49,37 @@ class TrainState(NamedTuple):
     comm_error: Dict
 
 
+def init_comm_error(params, comm: Optional[CommConfig], n_dev: int) -> Dict:
+    """Zero error-feedback residuals for every TOPK layer, stacked
+    (n_dev, *shape): each device keeps its own residual (local gradients
+    differ), sharded over the data axis."""
+    comm = comm or CommConfig()
+    return {
+        lname: {k: jnp.zeros((n_dev,) + v.shape, v.dtype)
+                for k, v in lparams.items()}
+        for lname, lparams in params.items()
+        if comm.strategy_for(lname) == TOPK}
+
+
+def reconcile_comm_error(params, err: Dict, comm: Optional[CommConfig],
+                         n_dev: int) -> Dict:
+    """Adapt restored residuals to the current comm config: keep residuals
+    for layers that are still TOPK (shape permitting), zero-init layers that
+    became TOPK, drop the rest."""
+    fresh = init_comm_error(params, comm, n_dev)
+    out = {}
+    for lname, zeros in fresh.items():
+        old = err.get(lname, {})
+        out[lname] = {
+            k: old[k] if k in old and old[k].shape == z.shape else z
+            for k, z in zeros.items()}
+    return out
+
+
 def init_train_state(params, comm: Optional[CommConfig] = None,
                      n_dev: int = 1) -> TrainState:
-    """comm_error leaves are stacked (n_dev, *shape): each device keeps its
-    own residual (local gradients differ), sharded over the data axis."""
-    comm = comm or CommConfig()
-    errors = {}
-    for lname, lparams in params.items():
-        if comm.strategy_for(lname) == TOPK:
-            errors[lname] = {
-                k: jnp.zeros((n_dev,) + v.shape, v.dtype)
-                for k, v in lparams.items()}
-    return TrainState(solver=init_state(params), comm_error=errors)
+    return TrainState(solver=init_state(params),
+                      comm_error=init_comm_error(params, comm, n_dev))
 
 
 @dataclass
@@ -168,11 +187,17 @@ def build_eval_step(net: Net, mesh: Mesh, axis: str = "data") -> Callable:
 
 class SSPState(NamedTuple):
     """Per-device divergent params (stacked on a leading device dim, sharded
-    over the data axis) + the replicated anchor they diverged from."""
+    over the data axis) + the replicated anchor they diverged from.
+
+    ``comm_error`` carries the error-feedback residual for TOPK layers whose
+    *delta* exchange is compressed at sync boundaries (the SSPAggr
+    composition: bounded staleness + bandwidth-managed communication,
+    ssp_aggr_bg_worker.cpp). Same stacked-per-device layout as the params."""
     local_params: Dict   # leaves: (n_dev, *shape), sharded on axis 0
     local_history: Dict  # momentum/adagrad history, same layout
     anchor_params: Dict  # leaves: (*shape,), replicated
     it: jax.Array
+    comm_error: Dict     # TOPK residuals: (n_dev, *shape), sharded on axis 0
 
 
 def build_ssp_train_step(
@@ -189,6 +214,17 @@ def build_ssp_train_step(
     anchor — each replica's view is then at most s steps behind the aggregate,
     the SSP bound. This trades the reference's asynchronous clock machinery
     for a compiled, deterministic schedule with identical staleness semantics.
+
+    Per-layer strategies compose at the sync boundary:
+      DENSE — dense psum of the accumulated delta (default);
+      TOPK  — magnitude top-k compression of the delta with error feedback
+              (the SSPAggr pairing of staleness + bandwidth budget);
+      LOCAL — never synchronized (the reference's LOCAL blob mode; replicas
+              keep divergent copies, legal here unlike in the sync step).
+    SFB is rejected: it is a *backward-time* per-step factor exchange — under
+    SSP there is no per-step exchange to ride on (the reference's SVB likewise
+    drains sufficient vectors every iteration, i.e. it runs each FC layer at
+    effective staleness 0; if you want SFB, use build_train_step).
     """
     comm = comm or CommConfig()
     axis = comm.axis
@@ -196,11 +232,25 @@ def build_ssp_train_step(
     period = staleness + 1
     n_dev = mesh.shape[axis]
 
+    for lname in net.param_defs:
+        if comm.strategy_for(lname) == SFB:
+            raise ValueError(
+                f"layer {lname!r}: SFB is a per-step backward-time exchange "
+                f"and cannot compose with SSP local steps; use DENSE or TOPK "
+                f"(delta compression) under staleness > 0")
+
+    topk_layers = [l for l in net.param_defs
+                   if comm.strategy_for(l) == TOPK]
+    local_layers = {l for l in net.param_defs
+                    if comm.strategy_for(l) == LOCAL}
+    topk_fraction = budget_topk_fraction(net, comm)
+
     def device_step(ssp: SSPState, batch, rng):
         rng = jax.random.fold_in(rng, lax.axis_index(axis))
         squeeze = lambda tree: jax.tree_util.tree_map(lambda x: x[0], tree)
         local = squeeze(ssp.local_params)
         history = squeeze(ssp.local_history)
+        error = squeeze(ssp.comm_error)
 
         def loss_fn(p):
             out = net.apply(p, batch, train=True, rng=rng, comm=None)
@@ -211,32 +261,64 @@ def build_ssp_train_step(
             local, grads, SolverState(it=ssp.it, history=history))
 
         do_sync = (new_solver.it % period) == 0
+        scale = 1.0 / n_dev if comm.reduce == "mean" else 1.0
 
         def sync(args):
-            l, anchor = args
-            scale = 1.0 / n_dev if comm.reduce == "mean" else 1.0
-            merged = jax.tree_util.tree_map(
-                lambda lv, av: av + scale * lax.psum(lv - av, axis), l, anchor)
-            return merged, merged
+            l, anchor, err = args
+            merged, new_anchor, new_err = {}, {}, dict(err)
+            for lname, lp in l.items():
+                if lname in local_layers:
+                    # LOCAL blobs never cross the wire (blob.cpp LOCAL mode)
+                    merged[lname] = lp
+                    new_anchor[lname] = anchor[lname]
+                    continue
+                merged[lname], new_anchor[lname] = {}, {}
+                is_topk = lname in topk_layers
+                lerr = {}
+                for pname, lv in lp.items():
+                    av = anchor[lname][pname]
+                    delta = lv - av
+                    if is_topk:
+                        sent, resid = topk_compress(
+                            delta, topk_fraction, err[lname][pname])
+                        lerr[pname] = resid
+                        delta = sent
+                    m = av + scale * lax.psum(delta, axis)
+                    merged[lname][pname] = m
+                    new_anchor[lname][pname] = m
+                if is_topk:
+                    new_err[lname] = lerr
+            return merged, new_anchor, new_err
 
-        new_local, new_anchor = lax.cond(
-            do_sync, sync, lambda args: args, (new_local, ssp.anchor_params))
+        new_local, new_anchor, new_error = lax.cond(
+            do_sync, sync, lambda args: args,
+            (new_local, ssp.anchor_params, error))
         metrics = {"loss": lax.psum(out.loss, axis) / n_dev}
+        for name, val in out.outputs.items():
+            if val.ndim == 0:
+                metrics[name] = lax.psum(val.astype(jnp.float32), axis) / n_dev
         unsq = lambda tree: jax.tree_util.tree_map(lambda x: x[None], tree)
         return SSPState(unsq(new_local), unsq(new_solver.history),
-                        new_anchor, new_solver.it), metrics
+                        new_anchor, new_solver.it, unsq(new_error)), metrics
 
     sharded = jax.shard_map(
         device_step, mesh=mesh,
-        in_specs=(SSPState(P(axis), P(axis), P(), P()), P(axis), P()),
-        out_specs=(SSPState(P(axis), P(axis), P(), P()), P()),
+        in_specs=(SSPState(P(axis), P(axis), P(), P(), P(axis)), P(axis), P()),
+        out_specs=(SSPState(P(axis), P(axis), P(), P(), P(axis)), P()),
         check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,))
+    return TrainStep(
+        step=jax.jit(sharded, donate_argnums=(0,)),
+        mesh=mesh,
+        batch_sharding=NamedSharding(mesh, P(axis)),
+        replicated=NamedSharding(mesh, P()),
+    )
 
 
-def init_ssp_state(params, n_dev: int) -> SSPState:
+def init_ssp_state(params, n_dev: int,
+                   comm: Optional[CommConfig] = None) -> SSPState:
     stack = lambda tree: jax.tree_util.tree_map(
         lambda x: jnp.broadcast_to(x[None], (n_dev,) + x.shape), tree)
     zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
     return SSPState(local_params=stack(params), local_history=stack(zeros),
-                    anchor_params=params, it=jnp.zeros((), jnp.int32))
+                    anchor_params=params, it=jnp.zeros((), jnp.int32),
+                    comm_error=init_comm_error(params, comm, n_dev))

@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.net import Net
-from ..parallel.trainer import TrainState
+from ..parallel.trainer import (SSPState, TrainState, init_comm_error,
+                                init_ssp_state, reconcile_comm_error)
 from ..proto.wire import decode_caffemodel, encode_caffemodel
 from ..solvers.updates import SolverState
 
@@ -55,28 +56,59 @@ def _unflatten(flat: Dict[str, np.ndarray]):
     return tree
 
 
-def snapshot(prefix: str, net: Net, params, state: TrainState) -> Tuple[str, str]:
+def snapshot(prefix: str, net: Net, params, state) -> Tuple[str, str]:
     """Write both artifacts atomically (tmp + rename): with replicated state
     every rank writes identical bytes, so even concurrent snapshots to a
-    shared filesystem are safe — the last rename wins with valid content."""
-    it = int(state.solver.it)
+    shared filesystem are safe — the last rename wins with valid content.
+
+    ``state`` is either a TrainState (sync/dense training) or an SSPState
+    (staleness > 0); the .solverstate records which, so restore() rebuilds the
+    right carry — the analog of the reference's per-thread .solverstate files
+    carrying divergent worker histories (solver.cpp:654-667)."""
+    is_ssp = isinstance(state, SSPState)
+    it = int(state.it if is_ssp else state.solver.it)
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
     model_path = f"{prefix}_iter_{it}.caffemodel"
     state_path = f"{prefix}_iter_{it}.solverstate.npz"
     pid = os.getpid()
 
+    # .caffemodel always holds the globally-agreed view: anchor under SSP.
+    model_params = state.anchor_params if is_ssp else params
     tmp = f"{model_path}.tmp.{pid}"
     with open(tmp, "wb") as f:
-        f.write(encode_caffemodel(net.name or "net", net.export_weights(params)))
+        f.write(encode_caffemodel(net.name or "net",
+                                  net.export_weights(model_params)))
     os.replace(tmp, model_path)
 
-    arrays = {}
-    arrays.update({f"params/{k}": v for k, v in _flatten(params).items()})
-    arrays.update({f"history/{k}": v
-                   for k, v in _flatten(state.solver.history).items()})
+    # Per-device SSP leaves (and TOPK residuals) are sharded over the data
+    # axis; under multi-process they span non-addressable devices, so gather
+    # them to every host first — each rank then writes identical bytes again.
+    def gather(tree):
+        if jax.process_count() == 1 or not jax.tree_util.tree_leaves(tree):
+            return tree
+        from jax.experimental import multihost_utils
+        return jax.tree_util.tree_map(
+            lambda x: multihost_utils.process_allgather(x, tiled=True)
+            if isinstance(x, jax.Array) and not x.is_fully_addressable else x,
+            tree)
+
+    arrays = {"iter": np.asarray(it)}
+    if is_ssp:
+        arrays["kind"] = np.asarray("ssp")
+        arrays.update({f"params/{k}": v
+                       for k, v in _flatten(state.anchor_params).items()})
+        arrays.update({f"local_params/{k}": v
+                       for k, v in _flatten(gather(state.local_params)).items()})
+        arrays.update({f"local_history/{k}": v
+                       for k, v in
+                       _flatten(gather(state.local_history)).items()})
+    else:
+        arrays["kind"] = np.asarray("dense")
+        arrays.update({f"params/{k}": v for k, v in _flatten(params).items()})
+        arrays.update({f"history/{k}": v
+                       for k, v in _flatten(state.solver.history).items()})
     arrays.update({f"comm_error/{k}": v
-                   for k, v in _flatten(state.comm_error).items()})
-    arrays["iter"] = np.asarray(it)
+                   for k, v in _flatten(gather(state.comm_error)).items()})
     tmp = f"{state_path}.tmp.{pid}"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
@@ -84,25 +116,73 @@ def snapshot(prefix: str, net: Net, params, state: TrainState) -> Tuple[str, str
     return model_path, state_path
 
 
-def restore(state_path: str) -> Tuple[Dict, TrainState]:
+def restore(state_path: str) -> Tuple[Dict, object]:
+    """Rebuild (params, state) from a .solverstate.npz. The state is a
+    TrainState or SSPState depending on how the snapshot was taken; callers
+    running in the other mode can convert via ``coerce_state``."""
     z = np.load(state_path)
-    params_flat, hist_flat, err_flat = {}, {}, {}
+    groups: Dict[str, Dict[str, np.ndarray]] = {}
     it = 0
+    kind = "dense"
     for key in z.files:
         if key == "iter":
             it = int(z[key])
-        elif key.startswith("params/"):
-            params_flat[key[len("params/"):]] = z[key]
-        elif key.startswith("history/"):
-            hist_flat[key[len("history/"):]] = z[key]
-        elif key.startswith("comm_error/"):
-            err_flat[key[len("comm_error/"):]] = z[key]
-    params = _unflatten(params_flat)
-    state = TrainState(
-        solver=SolverState(it=jnp.asarray(it, jnp.int32),
-                           history=_unflatten(hist_flat)),
-        comm_error=_unflatten(err_flat))
+        elif key == "kind":
+            kind = str(z[key])
+        else:
+            group, rest = key.split("/", 1)
+            groups.setdefault(group, {})[rest] = z[key]
+    params = _unflatten(groups.get("params", {}))
+    it_arr = jnp.asarray(it, jnp.int32)
+    err = _unflatten(groups.get("comm_error", {}))
+    if kind == "ssp":
+        state = SSPState(
+            local_params=_unflatten(groups.get("local_params", {})),
+            local_history=_unflatten(groups.get("local_history", {})),
+            anchor_params=params, it=it_arr, comm_error=err)
+    else:
+        state = TrainState(
+            solver=SolverState(it=it_arr,
+                               history=_unflatten(groups.get("history", {}))),
+            comm_error=err)
     return params, state
+
+
+def coerce_state(params, state, *, staleness: int, n_dev: int, comm=None):
+    """Adapt a restored state to the engine's current mode.
+
+    dense -> SSP: broadcast params to fresh per-device copies (histories
+    restart, like the reference's thread-0 fallback in Restore).
+    SSP -> dense: collapse to the anchor view with fresh history.
+    Matching modes pass through (with an n_dev check for SSP), reconciling
+    comm_error against the engine's *current* comm config — layers that
+    changed strategy get fresh/dropped residuals. On a mode CHANGE the
+    residuals restart at zero instead: dense residuals hold per-step gradient
+    mass while SSP residuals hold per-period parameter-delta mass — different
+    units, so carrying them over would inject a wrongly-scaled correction at
+    the first sync (histories restart on mode change for the same reason)."""
+    from ..solvers.updates import init_state
+
+    def fix_err(p, st):
+        return st._replace(comm_error=reconcile_comm_error(
+            p, st.comm_error, comm, n_dev))
+
+    want_ssp = staleness > 0
+    is_ssp = isinstance(state, SSPState)
+    if want_ssp and not is_ssp:
+        fresh = init_ssp_state(params, n_dev, comm)  # zero residuals
+        return params, fresh._replace(it=state.solver.it)
+    if not want_ssp and is_ssp:
+        anchor = state.anchor_params
+        return anchor, TrainState(
+            solver=init_state(anchor)._replace(it=state.it),
+            comm_error=init_comm_error(anchor, comm, n_dev))
+    if is_ssp:
+        stored_dev = jax.tree_util.tree_leaves(state.local_params)[0].shape[0]
+        if stored_dev != n_dev:
+            fresh = init_ssp_state(state.anchor_params, n_dev, comm)
+            return state.anchor_params, fresh._replace(it=state.it)
+    return params, fix_err(params, state)
 
 
 def load_caffemodel(path: str, net: Net, params):

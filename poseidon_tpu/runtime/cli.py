@@ -42,11 +42,9 @@ def _engine_from_args(args, phase_nets=True):
                       reduce=args.grad_reduce)
     if args.sfb_auto:
         comm = CommConfig(reduce=args.grad_reduce)
-    eng = Engine(sp, comm=comm, output_dir=args.output_dir)
-    if args.sfb_auto:
-        from ..parallel.strategies import auto_strategies
-        comm.layer_strategies.update(auto_strategies(eng.train_net))
-    return eng
+    staleness = getattr(args, "staleness", 0)
+    return Engine(sp, comm=comm, output_dir=args.output_dir,
+                  staleness=staleness, sfb_auto=args.sfb_auto)
 
 
 def cmd_train(args) -> int:
@@ -257,6 +255,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sfb-auto", action="store_true",
                    help="pick SFB per FC layer by cost model (SACP)")
     t.add_argument("--grad-reduce", default="mean", choices=["mean", "sum"])
+    t.add_argument("--staleness", type=int, default=0,
+                   help="SSP bound s: devices run local steps, reconciling "
+                        "every s+1 iters (0 = synchronous, the reference's "
+                        "recommended setting)")
     t.add_argument("--hostfile", default="",
                    help="cluster hostfile ('<id> <ip> <port>' lines)")
     t.add_argument("--node_id", type=int, default=-1,

@@ -33,8 +33,10 @@ from ..data.pipeline import (BatchPipeline, build_phase_pipelines,
                              layer_batch_size)
 from ..data.workload import Shard
 from ..core.layers import DATA_SOURCE_TYPES
-from ..parallel import (CommConfig, build_eval_step, build_train_step,
-                        init_train_state, make_mesh)
+from ..parallel import (CommConfig, build_eval_step, build_ssp_train_step,
+                        build_train_step, init_ssp_state, init_train_state,
+                        make_mesh)
+from ..parallel.trainer import SSPState, TrainStep
 from ..proto.messages import (NetParameter, SolverParameter, load_net,
                               load_solver)
 from ..solvers.updates import learning_rate
@@ -75,11 +77,14 @@ class Engine:
         mesh=None,
         memory_data: Optional[Dict[str, np.ndarray]] = None,
         output_dir: str = ".",
+        staleness: int = 0,
+        sfb_auto: bool = False,
     ):
         self.sp = sp
         self.mesh = mesh or make_mesh()
         self.n_dev = int(np.prod(list(self.mesh.shape.values())))
         self.comm = comm or CommConfig()
+        self.staleness = staleness
         self.output_dir = output_dir
         self.stats = StatsRegistry()
         self.rank = jax.process_index()
@@ -99,16 +104,49 @@ class Engine:
             self.test_nets.append(Net(tp, "TEST", source_shapes=shapes))
             self.test_pipelines.append(pipes)
 
+        if sfb_auto:
+            # SACP cost-model strategy choice must land before step building:
+            # build_*_train_step snapshots the strategy map eagerly. SFB is a
+            # per-step backward-time exchange, so under SSP (local steps, no
+            # per-step exchange) the auto picks stay DENSE instead.
+            if staleness > 0:
+                log("sfb_auto: SFB does not compose with SSP staleness; "
+                    "keeping DENSE delta sync for all layers", rank=self.rank)
+            else:
+                from ..parallel.strategies import auto_strategies
+                self.comm.layer_strategies.update(
+                    auto_strategies(self.train_net))
+
         # --- compiled steps ---------------------------------------------- #
-        self.train_step = build_train_step(self.train_net, sp, self.mesh,
-                                           self.comm)
+        if staleness > 0:
+            # SSP (ssp_consistency_controller.cpp): each device runs local
+            # steps, reconciling every staleness+1 iters. The engine's view
+            # of "the params" is the replicated anchor (what the PS holds).
+            ssp_ts = build_ssp_train_step(self.train_net, sp, self.mesh,
+                                          staleness, self.comm)
+            raw_step = ssp_ts.step
+
+            def _ssp_step(params, state, batch, rng):
+                state, m = raw_step(state, batch, rng)
+                return state.anchor_params, state, m
+
+            self.train_step = TrainStep(
+                step=_ssp_step, mesh=ssp_ts.mesh,
+                batch_sharding=ssp_ts.batch_sharding,
+                replicated=ssp_ts.replicated)
+        else:
+            self.train_step = build_train_step(self.train_net, sp, self.mesh,
+                                               self.comm)
         self.eval_steps = [build_eval_step(n, self.mesh) for n in self.test_nets]
 
         # --- state -------------------------------------------------------- #
         seed = sp.random_seed if sp.random_seed >= 0 else 1
         self.rng = jax.random.PRNGKey(seed)
         self.params = self.train_net.init(jax.random.fold_in(self.rng, 0))
-        self.state = init_train_state(self.params, self.comm, self.n_dev)
+        if staleness > 0:
+            self.state = init_ssp_state(self.params, self.n_dev, self.comm)
+        else:
+            self.state = init_train_state(self.params, self.comm, self.n_dev)
         self.metrics = MetricsTable("train")
         self.test_metrics = [MetricsTable(f"test_{i}")
                              for i in range(len(self.test_nets))]
@@ -153,14 +191,24 @@ class Engine:
         return batch
 
     # ---------------------------------------------------------------- #
+    def iteration(self) -> int:
+        return int(self.state.it if self.staleness > 0
+                   else self.state.solver.it)
+
     def restore_from(self, path: str):
         if path.endswith(".caffemodel"):
             self.params = load_caffemodel(path, self.train_net, self.params)
+            if self.staleness > 0:
+                self.state = init_ssp_state(self.params, self.n_dev, self.comm)
             log(f"Loaded weights from {path}", rank=self.rank)
         else:
-            self.params, self.state = restore(path)
+            from .checkpoint import coerce_state
+            params, state = restore(path)
+            self.params, self.state = coerce_state(
+                params, state, staleness=self.staleness, n_dev=self.n_dev,
+                comm=self.comm)
             log(f"Restored solver state from {path} "
-                f"(iter {int(self.state.solver.it)})", rank=self.rank)
+                f"(iter {self.iteration()})", rank=self.rank)
 
     def snapshot_now(self) -> Optional[str]:
         if not self.sp.snapshot_prefix:
@@ -214,7 +262,7 @@ class Engine:
     def train(self, max_iter: Optional[int] = None) -> Dict[str, float]:
         sp = self.sp
         max_iter = max_iter or sp.max_iter
-        it = int(self.state.solver.it)
+        it = self.iteration()
         t_start = time.time()
         last: Dict[str, float] = {}
         # profiler window: skip a couple of warmup/compile steps

@@ -147,10 +147,10 @@ def test_ssp_bounded_staleness(mesh, lenet_net, rng_np):
     params = lenet_net.init(jax.random.PRNGKey(0))
     batch = _global_batch(rng_np)
     staleness = 2
-    step = build_ssp_train_step(lenet_net, sp, mesh, staleness)
+    ts = build_ssp_train_step(lenet_net, sp, mesh, staleness)
     st = init_ssp_state(params, N_DEV)
     for i in range(1, 7):
-        st, m = step(st, batch, jax.random.PRNGKey(i))
+        st, m = ts.step(st, batch, jax.random.PRNGKey(i))
         local = np.asarray(st.local_params["conv1"]["w"])
         spread = np.abs(local - local[0:1]).max()
         if i % (staleness + 1) == 0:
@@ -160,6 +160,66 @@ def test_ssp_bounded_staleness(mesh, lenet_net, rng_np):
             # replicas allowed to drift between syncs
             assert np.isfinite(local).all()
     assert np.isfinite(float(m["loss"]))
+
+
+def test_ssp_converges_close_to_sync(mesh, lenet_net, rng_np):
+    """SSP s=2 must track synchronous training: after N iters on a fixed
+    batch, its loss lands within a small margin of the s=0 loss (the bounded
+    -staleness convergence claim, ssp_consistency_controller.cpp)."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    n_iters = 9  # multiple of period so the final iter is a sync point
+
+    sync_ts = build_train_step(lenet_net, sp, mesh, CommConfig(),
+                               donate=False)
+    p, s = params, init_train_state(params)
+    for i in range(n_iters):
+        p, s, m_sync = sync_ts.step(p, s, batch, jax.random.PRNGKey(i))
+
+    ssp_ts = build_ssp_train_step(lenet_net, sp, mesh, staleness=2)
+    st = init_ssp_state(params, N_DEV)
+    for i in range(n_iters):
+        st, m_ssp = ssp_ts.step(st, batch, jax.random.PRNGKey(i))
+
+    sync_loss, ssp_loss = float(m_sync["loss"]), float(m_ssp["loss"])
+    start_loss = float(np.log(10))
+    # both should have made real progress, and SSP shouldn't lag sync by more
+    # than a third of the progress sync made
+    assert sync_loss < 0.8 * start_loss
+    assert ssp_loss < sync_loss + 0.35 * (start_loss - sync_loss), \
+        f"ssp {ssp_loss} vs sync {sync_loss}"
+
+
+def test_ssp_topk_composition(mesh, lenet_net, rng_np):
+    """SSP + TOPK (the SSPAggr pairing): deltas are compressed at sync
+    boundaries, residuals carry error feedback, replicas stay consistent."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = CommConfig(default_strategy="topk", topk_fraction=0.1)
+    w0 = np.asarray(params["conv1"]["w"])  # copy before donation eats params
+    ts = build_ssp_train_step(lenet_net, sp, mesh, staleness=1, comm=cc)
+    st = init_ssp_state(params, N_DEV, cc)
+    assert "conv1" in st.comm_error
+    for i in range(1, 5):
+        st, m = ts.step(st, batch, jax.random.PRNGKey(i))
+        local = np.asarray(st.local_params["conv1"]["w"])
+        if i % 2 == 0:  # sync point: replicas identical again
+            assert np.abs(local - local[0:1]).max() == 0.0, f"iter {i}"
+    # error feedback holds the unsent delta mass (non-zero after a sync)
+    err = np.asarray(st.comm_error["conv1"]["w"])
+    assert np.abs(err).max() > 0
+    assert np.isfinite(float(m["loss"]))
+    # params moved
+    assert np.abs(np.asarray(st.anchor_params["conv1"]["w"]) - w0).max() > 0
+
+
+def test_ssp_rejects_sfb(mesh, lenet_net):
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed")
+    cc = CommConfig(layer_strategies={"ip1": SFB})
+    with pytest.raises(ValueError, match="SFB"):
+        build_ssp_train_step(lenet_net, sp, mesh, staleness=1, comm=cc)
 
 
 def test_bandwidth_budget_derives_topk_fraction(lenet_net):
