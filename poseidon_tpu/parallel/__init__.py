@@ -1,10 +1,11 @@
 from .mesh import DATA_AXIS, batch_sharding, make_mesh, replicated  # noqa: F401
 from .strategies import (  # noqa: F401
-    CommConfig, CommContext, DENSE, LOCAL, SFB, TOPK, auto_strategies,
-    topk_compress,
+    CommConfig, CommContext, DENSE, DENSE_FUSED, LOCAL, SFB, TOPK,
+    auto_strategies, topk_compress,
 )
 from .trainer import (  # noqa: F401
-    TrainState, build_eval_step, build_ssp_train_step, build_train_step,
-    init_ssp_state, init_train_state, param_mults,
+    SSPState, TrainState, build_eval_step, build_ssp_train_step,
+    build_train_step, comm_error_groups, init_comm_error, init_ssp_state,
+    init_train_state, param_mults, reconcile_comm_error,
 )
 from .sequence import ring_attention, ulysses_attention  # noqa: F401

@@ -47,11 +47,23 @@ DENSE = "dense"      # psum in backward (DWBP-style overlap) — the default
 SFB = "sfb"          # sufficient-factor broadcast for FC layers
 LOCAL = "local"      # no sync (the reference's LOCAL blob mode)
 TOPK = "topk"        # magnitude top-k compressed psum with error feedback
+# All psums issued together after the whole backward finishes — the
+# no-overlap baseline the reference compares DWBP against (one big sync at
+# the end of ForwardBackward instead of per-layer threads). Exists for A/B
+# measurement of the overlap win; not a production choice.
+DENSE_FUSED = "dense_fused"
 
 
 @dataclass
 class CommConfig:
     axis: str = "data"
+    # Optional second, slower tier (the multi-slice/DCN axis of a 2-D mesh).
+    # When set, DENSE/SFB collectives ride both axes jointly, while TOPK
+    # becomes hierarchical: dense psum intra-slice (fast ICI), then
+    # magnitude-compressed exchange inter-slice (bandwidth-limited DCN) —
+    # the SSPAggr deployment shape (ssp_aggr_server_thread.cpp:13-90:
+    # full-rate updates inside a machine, budgeted prioritized bytes across).
+    dcn_axis: Optional[str] = None
     default_strategy: str = DENSE
     layer_strategies: Dict[str, str] = dc_field(default_factory=dict)
     # "mean" is classic synchronous SGD: convergence matches single-machine
@@ -70,15 +82,24 @@ class CommConfig:
     def strategy_for(self, layer: str) -> str:
         return self.layer_strategies.get(layer, self.default_strategy)
 
+    @property
+    def sync_axes(self) -> tuple:
+        """Axis names dense collectives ride, outer (slow) tier first —
+        matches the batch layout P((dcn, data)) so tiled all_gathers
+        reassemble the global batch in order."""
+        if self.dcn_axis is not None:
+            return (self.dcn_axis, self.axis)
+        return (self.axis,)
 
-def _maybe_mean(g, axis: str, reduce: str):
+
+def _maybe_mean(g, axes: tuple, reduce: str):
     if reduce == "mean":
-        return g / lax.psum(jnp.ones((), g.dtype), axis)
+        return g / lax.psum(jnp.ones((), g.dtype), axes)
     return g
 
 
 @functools.lru_cache(maxsize=None)
-def _sync_tap(axis: str, reduce: str):
+def _sync_tap(axes: tuple, reduce: str):
     @jax.custom_vjp
     def tap(w):
         return w
@@ -87,14 +108,14 @@ def _sync_tap(axis: str, reduce: str):
         return w, None
 
     def bwd(_, g):
-        return (_maybe_mean(lax.psum(g, axis), axis, reduce),)
+        return (_maybe_mean(lax.psum(g, axes), axes, reduce),)
 
     tap.defvjp(fwd, bwd)
     return tap
 
 
 @functools.lru_cache(maxsize=None)
-def _sfb_matmul(axis: str, reduce: str, with_bias: bool):
+def _sfb_matmul(axes: tuple, reduce: str, with_bias: bool):
     """FC forward on the local shard; backward reconstructs global ∇W from
     all-gathered sufficient factors."""
 
@@ -127,16 +148,16 @@ def _sfb_matmul(axis: str, reduce: str, with_bias: bool):
             preferred_element_type=p.accum_dtype,
             precision=matmul_precision()).astype(x2.dtype)
         # sufficient factors: a = top diff (B, M), b = bottom data (B, K)
-        G = lax.all_gather(g, axis, tiled=True)       # (B_global, M)
-        X = lax.all_gather(x2, axis, tiled=True)      # (B_global, K)
+        G = lax.all_gather(g, axes, tiled=True)       # (B_global, M)
+        X = lax.all_gather(x2, axes, tiled=True)      # (B_global, K)
         gw = lax.dot_general(
             G.astype(p.compute_dtype), X.astype(p.compute_dtype),
             (((0,), (0,)), ((), ())),
             preferred_element_type=p.accum_dtype,
             precision=matmul_precision())     # (M, K) — global f32 sum
-        gw = _maybe_mean(gw, axis, reduce).astype(w.dtype)
+        gw = _maybe_mean(gw, axes, reduce).astype(w.dtype)
         if with_bias:
-            gb = _maybe_mean(lax.psum(jnp.sum(g, axis=0), axis), axis, reduce)
+            gb = _maybe_mean(lax.psum(jnp.sum(g, axis=0), axes), axes, reduce)
             return gx, gw, gb
         return gx, gw, None
 
@@ -169,20 +190,22 @@ class CommContext:
 
     def tap_param(self, layer: str, pname: str, w: jax.Array) -> jax.Array:
         strat = self.cfg.strategy_for(layer)
-        if strat in (LOCAL, TOPK):
+        if strat in (LOCAL, TOPK, DENSE_FUSED):
             # LOCAL: never synced. TOPK: the trainer compresses + psums the
             # raw local gradient after backward, carrying the error-feedback
-            # residual in TrainState.comm_error (trainer.py).
+            # residual in TrainState.comm_error (trainer.py). DENSE_FUSED:
+            # the trainer psums after the whole backward (no-overlap A/B).
             return w
-        return _sync_tap(self.cfg.axis, self.cfg.reduce)(w)
+        return _sync_tap(self.cfg.sync_axes, self.cfg.reduce)(w)
 
     def inner_product(self, layer: str, x, w, b) -> Optional[jax.Array]:
         if self.cfg.strategy_for(layer) != SFB:
             return None
+        axes = self.cfg.sync_axes
         x2 = x.reshape(x.shape[0], -1)
         if b is not None:
-            return _sfb_matmul(self.cfg.axis, self.cfg.reduce, True)(x2, w, b)
-        return _sfb_matmul(self.cfg.axis, self.cfg.reduce, False)(
+            return _sfb_matmul(axes, self.cfg.reduce, True)(x2, w, b)
+        return _sfb_matmul(axes, self.cfg.reduce, False)(
             x2, w, jnp.zeros((w.shape[0],), w.dtype))
 
 

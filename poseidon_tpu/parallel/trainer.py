@@ -21,14 +21,15 @@ from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.net import Net
 from ..proto.messages import SolverParameter
 from ..solvers.updates import SolverState, init_state, make_update_fn
-from .strategies import (CommConfig, CommContext, LOCAL, SFB, TOPK,
-                         budget_topk_fraction, topk_compress)
+from .strategies import (CommConfig, CommContext, DENSE_FUSED, LOCAL, SFB,
+                         TOPK, budget_topk_fraction, topk_compress)
 
 
 def param_mults(net: Net) -> Dict[str, Dict[str, tuple]]:
@@ -91,6 +92,17 @@ class TrainStep:
     replicated: NamedSharding
 
 
+def comm_error_groups(comm: Optional[CommConfig], mesh: Mesh) -> int:
+    """How many independent TOPK residuals exist: one per device on a flat
+    mesh (local gradients differ), one per DCN slice on a two-tier mesh (the
+    residual is computed from the intra-slice-summed gradient, identical on
+    every device of a slice)."""
+    comm = comm or CommConfig()
+    if comm.dcn_axis is not None:
+        return mesh.shape[comm.dcn_axis]
+    return int(np.prod(list(mesh.shape.values())))
+
+
 def build_train_step(
     net: Net,
     sp: SolverParameter,
@@ -98,11 +110,22 @@ def build_train_step(
     comm: Optional[CommConfig] = None,
     donate: bool = True,
 ) -> TrainStep:
+    """Compiled SPMD train step over ``mesh``.
+
+    With ``comm.dcn_axis`` set (two-tier mesh, e.g. axes ("dcn", "data")),
+    DENSE/SFB collectives ride both axes jointly, while TOPK layers become
+    hierarchical: dense psum inside each slice over the fast ICI axis, then
+    magnitude top-k compressed exchange *between* slices over the slow DCN
+    axis with per-slice error feedback — the SSPAggr analog
+    (ssp_aggr_server_thread.cpp: full-rate intra-machine, budgeted
+    prioritized bytes inter-machine)."""
     comm = comm or CommConfig()
     axis = comm.axis
+    dcn = comm.dcn_axis
+    axes = comm.sync_axes  # (dcn, data) or (data,)
     update_fn = make_update_fn(sp, param_mults(net))
     ctx = CommContext(comm)
-    n_dev = mesh.shape[axis]
+    n_total = int(np.prod([mesh.shape[a] for a in axes]))
 
     for lname in net.param_defs:
         if comm.strategy_for(lname) == LOCAL:
@@ -114,71 +137,94 @@ def build_train_step(
 
     topk_layers = [l for l in net.param_defs
                    if comm.strategy_for(l) == TOPK]
+    fused_layers = [l for l in net.param_defs
+                    if comm.strategy_for(l) == DENSE_FUSED]
     topk_fraction = budget_topk_fraction(net, comm)
+    batch_spec = P(axes) if dcn else P(axis)
+    err_spec = P(dcn) if dcn else P(axis)
 
     def device_step(params, state: TrainState, batch, rng):
-        rng = jax.random.fold_in(rng, lax.axis_index(axis))
+        flat_idx = lax.axis_index(axis)
+        if dcn:
+            flat_idx = flat_idx + mesh.shape[axis] * lax.axis_index(dcn)
+        rng = jax.random.fold_in(rng, flat_idx)
 
         def loss_fn(p):
             out = net.apply(p, batch, train=True, rng=rng, comm=ctx)
             return out.loss, out
 
         grads, out = jax.grad(loss_fn, has_aux=True)(params)
+        # DENSE_FUSED: one bulk psum after the whole backward — the
+        # no-overlap baseline for the DWBP A/B.
+        for lname in fused_layers:
+            for pname, g in grads[lname].items():
+                g_sync = lax.psum(g, axes)
+                if comm.reduce == "mean":
+                    g_sync = g_sync / n_total
+                grads[lname][pname] = g_sync
         # Managed-comm tier: TOPK layers were left un-psummed by the tap;
-        # compress the (residual-corrected) local gradient, exchange only
-        # the top-k entries, keep the remainder as next step's residual.
+        # compress the (residual-corrected) gradient, exchange only the
+        # top-k entries, keep the remainder as next step's residual.
         new_errors = dict(state.comm_error)
         for lname in topk_layers:
             lerr = {}
             for pname, g in grads[lname].items():
-                err = state.comm_error[lname][pname][0]  # unstack device dim
+                err = state.comm_error[lname][pname][0]  # unstack group dim
+                if dcn:
+                    # fast tier: dense sum inside the slice (cheap ICI);
+                    # slow tier: compressed exchange between slices
+                    g = lax.psum(g, axis)
                 sent, resid = topk_compress(g, topk_fraction, err)
-                g_sync = lax.psum(sent, axis)
+                g_sync = lax.psum(sent, dcn if dcn else axis)
                 if comm.reduce == "mean":
-                    g_sync = g_sync / n_dev
+                    g_sync = g_sync / n_total
                 grads[lname][pname] = g_sync
                 lerr[pname] = resid[None]
             new_errors[lname] = lerr
         new_params, new_solver = update_fn(params, grads, state.solver)
-        metrics = {"loss": lax.psum(out.loss, axis) / n_dev}
+        metrics = {"loss": lax.psum(out.loss, axes) / n_total}
         for name, val in out.outputs.items():
             if val.ndim == 0:
-                metrics[name] = lax.psum(val.astype(jnp.float32), axis) / n_dev
+                metrics[name] = lax.psum(val.astype(jnp.float32),
+                                         axes) / n_total
         return new_params, TrainState(new_solver, new_errors), metrics
 
     sharded = jax.shard_map(
         device_step,
         mesh=mesh,
-        in_specs=(P(), TrainState(P(), P(axis)), P(axis), P()),
-        out_specs=(P(), TrainState(P(), P(axis)), P()),
+        in_specs=(P(), TrainState(P(), err_spec), batch_spec, P()),
+        out_specs=(P(), TrainState(P(), err_spec), P()),
         check_vma=False,
     )
     step = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
     return TrainStep(
         step=step,
         mesh=mesh,
-        batch_sharding=NamedSharding(mesh, P(axis)),
+        batch_sharding=NamedSharding(mesh, batch_spec),
         replicated=NamedSharding(mesh, P()),
     )
 
 
-def build_eval_step(net: Net, mesh: Mesh, axis: str = "data") -> Callable:
+def build_eval_step(net: Net, mesh: Mesh, axis: str = "data",
+                    dcn_axis: Optional[str] = None) -> Callable:
     """Test-phase forward returning cross-replica-averaged scalar outputs."""
-    n_dev = mesh.shape[axis]
+    axes = (dcn_axis, axis) if dcn_axis else (axis,)
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+    batch_spec = P(axes) if dcn_axis else P(axis)
 
     def device_eval(params, batch):
         out = net.apply(params, batch, train=False)
         metrics = {}
         if out.loss.ndim == 0:
-            metrics["loss"] = lax.psum(out.loss, axis) / n_dev
+            metrics["loss"] = lax.psum(out.loss, axes) / n_dev
         for name, val in out.outputs.items():
             if val.ndim == 0:
-                metrics[name] = lax.psum(val.astype(jnp.float32), axis) / n_dev
+                metrics[name] = lax.psum(val.astype(jnp.float32), axes) / n_dev
         return metrics
 
     return jax.jit(jax.shard_map(
         device_eval, mesh=mesh,
-        in_specs=(P(), P(axis)), out_specs=P(), check_vma=False))
+        in_specs=(P(), batch_spec), out_specs=P(), check_vma=False))
 
 
 # --------------------------------------------------------------------------- #
@@ -227,6 +273,12 @@ def build_ssp_train_step(
     effective staleness 0; if you want SFB, use build_train_step).
     """
     comm = comm or CommConfig()
+    if comm.dcn_axis is not None:
+        raise ValueError(
+            "SSP staleness over a two-tier (dcn) mesh is not supported: "
+            "bounded staleness and hierarchical TOPK both manage the slow "
+            "tier — compose staleness with flat TOPK, or use the two-tier "
+            "sync step (build_train_step with comm.dcn_axis)")
     axis = comm.axis
     update_fn = make_update_fn(sp, param_mults(net))
     period = staleness + 1

@@ -42,8 +42,22 @@ def _engine_from_args(args, phase_nets=True):
                       reduce=args.grad_reduce)
     if args.sfb_auto:
         comm = CommConfig(reduce=args.grad_reduce)
+    mesh = None
+    dcn_slices = getattr(args, "dcn_slices", 0)
+    if dcn_slices > 1:
+        # two-tier mesh: slices over the slow (DCN) axis, devices within a
+        # slice over the fast (ICI) axis; TOPK layers compress inter-slice
+        import jax
+        from ..parallel import make_mesh
+        n = jax.device_count()
+        if n % dcn_slices:
+            raise SystemExit(f"--dcn_slices {dcn_slices} does not divide "
+                             f"{n} devices")
+        mesh = make_mesh(axes=("dcn", "data"),
+                         shape=(dcn_slices, n // dcn_slices))
+        comm.dcn_axis = "dcn"
     staleness = getattr(args, "staleness", 0)
-    return Engine(sp, comm=comm, output_dir=args.output_dir,
+    return Engine(sp, comm=comm, mesh=mesh, output_dir=args.output_dir,
                   staleness=staleness, sfb_auto=args.sfb_auto)
 
 
@@ -66,31 +80,46 @@ def cmd_train(args) -> int:
 
 def cmd_test(args) -> int:
     import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
     from ..core.net import Net
     from ..data.pipeline import build_phase_pipelines
+    from ..data.workload import Shard
     from ..parallel import build_eval_step, make_mesh
     from ..proto.messages import load_net
     from .checkpoint import load_caffemodel
+    from .cluster import init_distributed
 
+    init_distributed(hostfile=args.hostfile or None,
+                     node_id=args.node_id if args.node_id >= 0 else None)
     net_param = load_net(args.model)
     mesh = make_mesh()
-    pipes, shapes = build_phase_pipelines(net_param, "TEST",
-                                          jax.device_count())
+    rank, nproc = jax.process_index(), jax.process_count()
+    # each host scores a DISJOINT shard of the record space and contributes
+    # only its addressable devices' rows (Engine._build_pipelines semantics)
+    pipes, shapes = build_phase_pipelines(
+        net_param, "TEST", batch_multiplier=jax.local_device_count(),
+        shard=Shard(rank, nproc))
     net = Net(net_param, "TEST", source_shapes=shapes)
     params = net.init(jax.random.PRNGKey(0))
     if args.weights:
         params = load_caffemodel(args.weights, net, params)
     ev = build_eval_step(net, mesh)
+    sharding = NamedSharding(mesh, P("data"))
     acc = {}
     for _ in range(args.iterations):
         batch = {}
         for pipe in pipes:
             for k, v in next(pipe).items():
-                batch[k] = jax.device_put(v)
+                if nproc > 1:
+                    batch[k] = jax.make_array_from_process_local_data(
+                        sharding, v)
+                else:
+                    batch[k] = jax.device_put(v, sharding)
         for k, v in ev(params, batch).items():
             acc[k] = acc.get(k, 0.0) + float(v)
-    for k in sorted(acc):
-        print(f"{k}: {acc[k] / args.iterations:.4f}")
+    if rank == 0:
+        for k in sorted(acc):
+            print(f"{k}: {acc[k] / args.iterations:.4f}")
     for p in pipes:
         p.close()
     return 0
@@ -117,8 +146,28 @@ def cmd_time(args) -> int:
             if lp.canonical_type() in DATA_SOURCE_TYPES:
                 from ..data.pipeline import layer_batch_size
                 b = layer_batch_size(lp) or args.batch_size
-                c = lp.transform_param.crop_size or 224
-                shapes[lp.top[0]] = (b, 3, c, c)
+                chw = None
+                src = (lp.data_param.source or lp.image_data_param.source
+                       or lp.hdf5_data_param.source
+                       or lp.window_data_param.source)
+                if src:
+                    # read one record for the true (C, H, W) — a synthesized
+                    # 3x224x224 guess would mis-size every downstream layer
+                    try:
+                        from ..data.pipeline import build_source
+                        from ..data.workload import Shard
+                        s = build_source(lp, Shard(0, 1))
+                        arr, _ = s.read(0)
+                        chw = arr.shape
+                    except Exception:
+                        chw = None
+                if chw is None:
+                    c = lp.transform_param.crop_size or 224
+                    chw = (3, c, c)
+                if lp.transform_param.crop_size:
+                    chw = (chw[0], lp.transform_param.crop_size,
+                           lp.transform_param.crop_size)
+                shapes[lp.top[0]] = (b,) + tuple(chw)
                 if len(lp.top) > 1:
                     shapes[lp.top[1]] = (b,)
         net = Net(net_param, "TRAIN", source_shapes=shapes)
@@ -182,6 +231,44 @@ def cmd_time(args) -> int:
             except Exception as e:  # e.g. int-labeled losses fed zeros
                 print(f"{layer.name:<24}{layer.TYPE:<22}{'skip':>10} ({e})")
 
+    # Static per-layer comm accounting over a hypothetical mesh — what each
+    # strategy moves per step and what it saves vs dense (stats.hpp analog).
+    if args.per_layer and args.comm_devices > 1:
+        from ..parallel import CommConfig, auto_strategies
+        from .comm_stats import comm_summary, layer_comm_table
+        n = args.comm_devices
+        slices = args.dcn_slices
+        # purely static accounting — a {axis: size} shape dict models the
+        # requested topology without needing that many physical devices
+        if slices > 1:
+            if n % slices:
+                raise SystemExit(f"--dcn_slices {slices} does not divide "
+                                 f"--comm_devices {n}")
+            mesh_shape = {"dcn": slices, "data": n // slices}
+            cc = CommConfig(dcn_axis="dcn", default_strategy=args.strategy)
+        else:
+            mesh_shape = {"data": n}
+            cc = CommConfig(default_strategy=args.strategy)
+        if args.sfb_auto:
+            cc.layer_strategies.update(auto_strategies(net))
+        table = layer_comm_table(net, cc, mesh_shape)
+        print(f"\nComm bytes/step/device over {n} devices"
+              + (f" ({slices} DCN slices)" if slices > 1 else "") + ":")
+        print(f"{'layer':<24}{'strategy':<8}{'ici B':>12}{'dcn B':>12}"
+              f"{'vs dense':>10}{'est ms':>9}")
+        for lname, row in table.items():
+            print(f"{lname:<24}{row['strategy']:<8}"
+                  f"{row['ici_bytes_per_step']:>12}"
+                  f"{row['dcn_bytes_per_step']:>12}"
+                  f"{str(row['savings_vs_dense'] or '-'):>10}"
+                  f"{row['est_comm_ms']:>9}")
+        s = comm_summary(table, fb_ms)
+        print(f"total: {s['total_bytes_per_step']} B/step/dev, "
+              f"{s['savings_vs_dense'] or '-'}x vs dense, "
+              f"est comm {s['est_comm_ms_per_step']} ms "
+              f"({s.get('est_comm_fraction_if_unoverlapped', 0):.0%} of "
+              f"measured step if unoverlapped)")
+
     print(f"Average Forward pass: {fwd_ms:.3f} ms")
     print(f"Average Forward-Backward: {fb_ms:.3f} ms")
     print(f"Throughput: {batch / (fb_ms / 1e3):.1f} images/s "
@@ -220,18 +307,29 @@ def cmd_extract_features(args) -> int:
     import jax
     from ..core.net import Net
     from ..data.pipeline import build_phase_pipelines
+    from ..data.workload import Shard
     from ..proto.messages import load_net
     from .checkpoint import load_caffemodel
+    from .cluster import init_distributed
     from .tools import extract_features
 
+    init_distributed(hostfile=args.hostfile or None,
+                     node_id=args.node_id if args.node_id >= 0 else None)
+    rank, nproc = jax.process_index(), jax.process_count()
     net_param = load_net(args.model)
-    pipes, shapes = build_phase_pipelines(net_param, "TEST", 1)
+    # each process extracts a disjoint record shard and writes its own DBs —
+    # the reference's per-(client,thread) LevelDB naming
+    # (feature_extractor.cpp:43-80)
+    pipes, shapes = build_phase_pipelines(net_param, "TEST", 1,
+                                          shard=Shard(rank, nproc))
     net = Net(net_param, "TEST", source_shapes=shapes)
     params = net.init(jax.random.PRNGKey(0))
     if args.weights:
         params = load_caffemodel(args.weights, net, params)
+    prefix = args.out_prefix if nproc == 1 else \
+        f"{args.out_prefix}_client{rank}"
     extract_features(net, params, args.blobs.split(","), pipes[0],
-                     args.num_batches, args.out_prefix)
+                     args.num_batches, prefix)
     for p in pipes:
         p.close()
     return 0
@@ -255,6 +353,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sfb-auto", action="store_true",
                    help="pick SFB per FC layer by cost model (SACP)")
     t.add_argument("--grad-reduce", default="mean", choices=["mean", "sum"])
+    t.add_argument("--dcn_slices", type=int, default=0,
+                   help="split devices into N slices on a slow (DCN) mesh "
+                        "axis: dense sync intra-slice, TOPK-compressed "
+                        "exchange inter-slice (managed comm / SSPAggr)")
     t.add_argument("--staleness", type=int, default=0,
                    help="SSP bound s: devices run local steps, reconciling "
                         "every s+1 iters (0 = synchronous, the reference's "
@@ -271,6 +373,8 @@ def build_parser() -> argparse.ArgumentParser:
     te.add_argument("--model", required=True)
     te.add_argument("--weights", default="")
     te.add_argument("--iterations", type=int, default=50)
+    te.add_argument("--hostfile", default="")
+    te.add_argument("--node_id", type=int, default=-1)
     te.set_defaults(fn=cmd_test)
 
     ti = sub.add_parser("time", help="benchmark model fwd/bwd")
@@ -279,6 +383,16 @@ def build_parser() -> argparse.ArgumentParser:
     ti.add_argument("--batch_size", type=int, default=64)
     ti.add_argument("--per_layer", action="store_true",
                     help="also print per-layer forward times")
+    ti.add_argument("--comm_devices", type=int, default=0,
+                    help="with --per_layer: print static per-layer comm "
+                         "bytes/savings over this many devices")
+    ti.add_argument("--dcn_slices", type=int, default=0,
+                    help="with --comm_devices: model a two-tier mesh with "
+                         "this many DCN slices")
+    ti.add_argument("--strategy", default="dense",
+                    choices=["dense", "sfb", "topk"])
+    ti.add_argument("--sfb-auto", action="store_true",
+                    help="pick SFB per FC layer by cost model")
     ti.set_defaults(fn=cmd_time)
 
     dq = sub.add_parser("device_query", help="show accelerator info")
@@ -318,6 +432,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated blob names")
     ef.add_argument("--num_batches", type=int, default=10)
     ef.add_argument("--out_prefix", required=True)
+    ef.add_argument("--hostfile", default="")
+    ef.add_argument("--node_id", type=int, default=-1)
     ef.set_defaults(fn=cmd_extract_features)
     return p
 

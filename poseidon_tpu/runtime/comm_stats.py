@@ -1,0 +1,178 @@
+"""Static communication accounting: the stats.hpp analog for compiled SPMD.
+
+The reference instruments its data plane at runtime — bytes serialized per
+oplog clock, server push bytes, per-table Get/Inc latencies — via 198
+compile-time macros (ps/src/petuum_ps_common/util/stats.hpp:19-80) dumped as
+YAML at shutdown. In a compiled SPMD step the data plane is the set of
+collectives XLA emits, and their cost is *statically determined* by parameter
+shapes, the per-layer strategy, and the mesh — so the equivalent accounting
+can be computed exactly, per layer, before the first step runs:
+
+- DENSE  — ring all-reduce: each device sends/receives 2*(n-1)/n of the
+           param bytes per step.
+- SFB    — all-gather of the two sufficient factors (B_global, M) and
+           (B_global, K): each device receives (n-1)/n of both.
+- TOPK   — managed-comm tier: only the top-k entries are *logically*
+           exchanged (k * (4B index + value bytes)), the SSPAggr budget
+           accounting. (The compiled flat-mesh implementation psums a
+           sparsified dense tensor — logical bytes are what a wire-format
+           DCN transport pays, and what the bandwidth budget meters.)
+- LOCAL  — nothing crosses the wire.
+
+On a two-tier mesh (CommConfig.dcn_axis) bytes are split per tier: DENSE/SFB
+ride both axes; TOPK pays dense all-reduce intra-slice (fast ICI) and
+compressed exchange inter-slice (slow DCN).
+
+The per-run stats.yaml gains a ``comm:`` section with this table plus an
+estimated comm/compute split (TransTimeEstimate's mbps math,
+trans_time_estimate.hpp:10-15, applied to the static bytes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..parallel.strategies import (DENSE, LOCAL, SFB, TOPK, CommConfig,
+                                   budget_topk_fraction)
+
+# Default link-speed assumptions for the estimated comm-time split, in GB/s
+# per device. Overridable via CommCostModel; the absolute numbers matter less
+# than the ICI:DCN ratio that motivates the two-tier design.
+ICI_GBPS = 100.0   # intra-slice interconnect, per-device
+DCN_GBPS = 6.25    # inter-slice data-center network, per-device (~50 Gbit)
+
+
+@dataclass
+class CommCostModel:
+    ici_gbps: float = ICI_GBPS
+    dcn_gbps: float = DCN_GBPS
+    topk_index_bytes: int = 4
+
+
+def _allreduce_bytes(param_bytes: float, n: int) -> float:
+    """Ring all-reduce: reduce-scatter + all-gather, 2*(n-1)/n each way."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * param_bytes
+
+
+def _allgather_bytes(total_bytes: float, n: int) -> float:
+    """Ring all-gather: each device receives everyone else's shard."""
+    if n <= 1:
+        return 0.0
+    return (n - 1) / n * total_bytes
+
+
+def layer_comm_table(
+    net,
+    comm: Optional[CommConfig],
+    mesh,
+    cost: Optional[CommCostModel] = None,
+) -> Dict[str, Dict]:
+    """Per-layer static comm accounting: strategy, bytes per step per device
+    split by tier, the dense-alternative bytes, and the saving factor.
+
+    ``net`` is a built Net (param shapes + blob shapes known); bytes use the
+    active compute dtype for gradients.
+    """
+    from ..config import policy
+    comm = comm or CommConfig()
+    cost = cost or CommCostModel()
+    dtype_bytes = np.dtype(policy().compute_dtype).itemsize
+
+    # accounting is purely static — accept a real Mesh OR a plain
+    # {axis: size} dict, so hypothetical topologies need no physical devices
+    shape = dict(mesh) if isinstance(mesh, dict) else dict(mesh.shape)
+    n_ici = shape[comm.axis]
+    n_dcn = shape[comm.dcn_axis] if comm.dcn_axis else 1
+    n_total = n_ici * n_dcn
+    topk_fraction = budget_topk_fraction(net, comm)
+
+    table: Dict[str, Dict] = {}
+    for layer in net.layers:
+        defs = net.param_defs.get(layer.name)
+        if not defs:
+            continue
+        strategy = comm.strategy_for(layer.name)
+        param_count = sum(p.count for p in defs)
+        param_bytes = param_count * dtype_bytes
+        dense_ici = _allreduce_bytes(param_bytes, n_total if n_dcn == 1
+                                     else n_ici)
+        dense_dcn = _allreduce_bytes(param_bytes, n_dcn) if n_dcn > 1 else 0.0
+
+        ici_b = dcn_b = 0.0
+        if strategy == DENSE:
+            ici_b, dcn_b = dense_ici, dense_dcn
+        elif strategy == SFB:
+            # factors: a = top diff (B_global, M), b = bottom data (B_global, K)
+            wdef = next((p for p in defs if len(p.shape) == 2), None)
+            if wdef is not None:
+                m, k = wdef.shape
+                b_global = net.blob_shapes[layer.lp.bottom[0]][0] * n_total
+                total = b_global * (m + k) * dtype_bytes
+                ici_b = _allgather_bytes(total, n_total if n_dcn == 1
+                                         else n_ici)
+                dcn_b = _allgather_bytes(total, n_dcn) if n_dcn > 1 else 0.0
+                # bias still rides a dense psum
+                bias = sum(p.count for p in defs) - m * k
+                ici_b += _allreduce_bytes(bias * dtype_bytes,
+                                          n_total if n_dcn == 1 else n_ici)
+            else:
+                ici_b, dcn_b = dense_ici, dense_dcn
+        elif strategy == TOPK:
+            k_entries = max(1, int(param_count * topk_fraction))
+            logical = k_entries * (cost.topk_index_bytes + dtype_bytes)
+            if n_dcn > 1:
+                # hierarchical: dense all-reduce intra-slice, compressed
+                # exchange inter-slice
+                ici_b = dense_ici
+                dcn_b = _allreduce_bytes(logical, n_dcn)
+            else:
+                ici_b = _allreduce_bytes(logical, n_total)
+        elif strategy == LOCAL:
+            pass
+
+        dense_total = dense_ici + dense_dcn
+        sent_total = ici_b + dcn_b
+        est_ms = (ici_b / (cost.ici_gbps * 1e9) +
+                  dcn_b / (cost.dcn_gbps * 1e9)) * 1e3
+        table[layer.name] = {
+            "strategy": strategy,
+            "param_count": int(param_count),
+            "ici_bytes_per_step": int(ici_b),
+            "dcn_bytes_per_step": int(dcn_b),
+            "dense_alternative_bytes": int(dense_total),
+            # None (YAML null) when nothing is sent — inf is not valid YAML
+            "savings_vs_dense": round(dense_total / sent_total, 2)
+            if sent_total else None,
+            "est_comm_ms": round(est_ms, 4),
+        }
+    return table
+
+
+def comm_summary(table: Dict[str, Dict],
+                 measured_step_ms: Optional[float] = None) -> Dict:
+    """Run-level totals + the comm/compute split estimate."""
+    ici = sum(r["ici_bytes_per_step"] for r in table.values())
+    dcn = sum(r["dcn_bytes_per_step"] for r in table.values())
+    dense = sum(r["dense_alternative_bytes"] for r in table.values())
+    est_ms = sum(r["est_comm_ms"] for r in table.values())
+    out = {
+        "ici_bytes_per_step": int(ici),
+        "dcn_bytes_per_step": int(dcn),
+        "total_bytes_per_step": int(ici + dcn),
+        "dense_alternative_bytes": int(dense),
+        "savings_vs_dense": round(dense / (ici + dcn), 2)
+        if (ici + dcn) else None,
+        "est_comm_ms_per_step": round(est_ms, 4),
+    }
+    if measured_step_ms:
+        # upper bound: assumes zero overlap; the DWBP-style in-backward taps
+        # exist precisely to hide this fraction behind compute
+        out["measured_step_ms"] = round(measured_step_ms, 4)
+        out["est_comm_fraction_if_unoverlapped"] = round(
+            min(1.0, est_ms / measured_step_ms), 4)
+    return out

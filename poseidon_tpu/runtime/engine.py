@@ -36,7 +36,7 @@ from ..core.layers import DATA_SOURCE_TYPES
 from ..parallel import (CommConfig, build_eval_step, build_ssp_train_step,
                         build_train_step, init_ssp_state, init_train_state,
                         make_mesh)
-from ..parallel.trainer import SSPState, TrainStep
+from ..parallel.trainer import SSPState, TrainStep, comm_error_groups
 from ..proto.messages import (NetParameter, SolverParameter, load_net,
                               load_solver)
 from ..solvers.updates import learning_rate
@@ -137,16 +137,20 @@ class Engine:
         else:
             self.train_step = build_train_step(self.train_net, sp, self.mesh,
                                                self.comm)
-        self.eval_steps = [build_eval_step(n, self.mesh) for n in self.test_nets]
+        self.eval_steps = [
+            build_eval_step(n, self.mesh, dcn_axis=self.comm.dcn_axis)
+            for n in self.test_nets]
 
         # --- state -------------------------------------------------------- #
         seed = sp.random_seed if sp.random_seed >= 0 else 1
         self.rng = jax.random.PRNGKey(seed)
         self.params = self.train_net.init(jax.random.fold_in(self.rng, 0))
+        self.err_groups = comm_error_groups(self.comm, self.mesh)
         if staleness > 0:
             self.state = init_ssp_state(self.params, self.n_dev, self.comm)
         else:
-            self.state = init_train_state(self.params, self.comm, self.n_dev)
+            self.state = init_train_state(self.params, self.comm,
+                                          self.err_groups)
         self.metrics = MetricsTable("train")
         self.test_metrics = [MetricsTable(f"test_{i}")
                              for i in range(len(self.test_nets))]
@@ -205,7 +209,8 @@ class Engine:
             from .checkpoint import coerce_state
             params, state = restore(path)
             self.params, self.state = coerce_state(
-                params, state, staleness=self.staleness, n_dev=self.n_dev,
+                params, state, staleness=self.staleness,
+                n_dev=self.n_dev if self.staleness > 0 else self.err_groups,
                 comm=self.comm)
             log(f"Restored solver state from {path} "
                 f"(iter {self.iteration()})", rank=self.rank)
@@ -340,6 +345,17 @@ class Engine:
     def _write_artifacts(self):
         if self.rank != 0:
             return
+        # static per-layer comm accounting + comm/compute split estimate
+        # (the stats.hpp bytes-per-clock analog, computed from shapes)
+        from .comm_stats import comm_summary, layer_comm_table
+        table = layer_comm_table(self.train_net, self.comm, self.mesh)
+        iters = self.stats.counters.get("train_iters", 0)
+        step_ms = (self.stats.timers.get("train_step", 0.0) / iters * 1e3
+                   if iters else None)
+        self.stats.set_section("comm", {
+            "summary": comm_summary(table, step_ms),
+            "per_layer": table,
+        })
         name = self.train_net.name or "net"
         self.metrics.to_csv(os.path.join(self.output_dir,
                                          f"{name}_train_outputs.csv"))

@@ -50,17 +50,35 @@ class MetricsTable:
 
 
 class StatsRegistry:
-    """Run-level counters/timers dumped as one YAML per run (stats.hpp analog)."""
+    """Run-level counters/timers dumped as one YAML per run (stats.hpp analog).
+
+    ``set_section`` attaches a nested dict (e.g. the static per-layer comm
+    accounting from comm_stats.py — the analog of the reference's bg oplog
+    bytes / server push bytes stats)."""
 
     def __init__(self):
         self.counters: Dict[str, float] = defaultdict(float)
         self.timers: Dict[str, float] = defaultdict(float)
+        self.sections: Dict[str, dict] = {}
 
     def add(self, name: str, value: float = 1.0) -> None:
         self.counters[name] += value
 
     def add_time(self, name: str, seconds: float) -> None:
         self.timers[name] += seconds
+
+    def set_section(self, name: str, data: dict) -> None:
+        self.sections[name] = data
+
+    @staticmethod
+    def _write_tree(f, tree: dict, indent: int) -> None:
+        pad = "  " * indent
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                f.write(f"{pad}{k}:\n")
+                StatsRegistry._write_tree(f, v, indent + 1)
+            else:
+                f.write(f"{pad}{k}: {'null' if v is None else v}\n")
 
     def dump_yaml(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -71,6 +89,9 @@ class StatsRegistry:
             f.write("timers_sec:\n")
             for k in sorted(self.timers):
                 f.write(f"  {k}: {round(self.timers[k], 6)}\n")
+            for name in sorted(self.sections):
+                f.write(f"{name}:\n")
+                self._write_tree(f, self.sections[name], 1)
 
 
 def log(msg: str, *, rank: int = 0) -> None:

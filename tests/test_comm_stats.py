@@ -1,0 +1,132 @@
+"""Static comm accounting (runtime/comm_stats.py): the stats.hpp analog."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from poseidon_tpu.core.net import Net
+from poseidon_tpu.models import zoo
+from poseidon_tpu.parallel import CommConfig, SFB, make_mesh
+from poseidon_tpu.runtime.comm_stats import (CommCostModel, comm_summary,
+                                             layer_comm_table)
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def lenet():
+    return Net(zoo.lenet(with_accuracy=False), phase="TRAIN",
+               source_shapes=zoo.lenet_shapes(2))
+
+
+def _dtype_bytes():
+    from poseidon_tpu.config import policy
+    return np.dtype(policy().compute_dtype).itemsize
+
+
+def test_dense_allreduce_bytes(lenet):
+    mesh = make_mesh()
+    table = layer_comm_table(lenet, CommConfig(), mesh)
+    b = _dtype_bytes()
+    # conv1: 20*1*5*5 + 20 params, ring all-reduce 2*(n-1)/n
+    want = 2 * (N_DEV - 1) / N_DEV * (20 * 25 + 20) * b
+    assert table["conv1"]["ici_bytes_per_step"] == int(want)
+    assert table["conv1"]["dcn_bytes_per_step"] == 0
+    assert table["conv1"]["strategy"] == "dense"
+    # dense == its own alternative: savings 1x
+    assert table["conv1"]["savings_vs_dense"] == 1.0
+
+
+def test_sfb_beats_dense_for_big_fc(lenet):
+    mesh = make_mesh()
+    cc = CommConfig(layer_strategies={"ip1": SFB})
+    table = layer_comm_table(lenet, cc, mesh)
+    row = table["ip1"]  # 500x800 weight, batch 2/dev
+    assert row["strategy"] == "sfb"
+    # factors: 16*(500+800) entries vs 400500-entry dense matrix
+    assert row["savings_vs_dense"] > 5
+    assert row["ici_bytes_per_step"] < row["dense_alternative_bytes"]
+
+
+def test_topk_logical_bytes(lenet):
+    mesh = make_mesh()
+    cc = CommConfig(default_strategy="topk", topk_fraction=0.01)
+    table = layer_comm_table(lenet, cc, mesh)
+    b = _dtype_bytes()
+    row = table["ip1"]
+    k = int((500 * 800 + 500) * 0.01)
+    want = 2 * (N_DEV - 1) / N_DEV * k * (4 + b)
+    assert row["ici_bytes_per_step"] == pytest.approx(want, rel=0.01)
+    assert row["savings_vs_dense"] > 10
+
+
+def test_two_tier_split(lenet):
+    mesh = make_mesh(axes=("dcn", "data"), shape=(2, 4))
+    cc = CommConfig(dcn_axis="dcn", default_strategy="topk",
+                    topk_fraction=0.01)
+    table = layer_comm_table(lenet, cc, mesh)
+    row = table["ip1"]
+    b = _dtype_bytes()
+    # intra-slice: dense all-reduce over 4 devices
+    dense_ici = 2 * 3 / 4 * (500 * 800 + 500) * b
+    assert row["ici_bytes_per_step"] == int(dense_ici)
+    # inter-slice: compressed exchange over 2 slices
+    assert 0 < row["dcn_bytes_per_step"] < row["ici_bytes_per_step"]
+    # the dcn tier being slow is the whole point: est time is dcn-dominated
+    cost = CommCostModel()
+    dcn_ms = row["dcn_bytes_per_step"] / (cost.dcn_gbps * 1e9) * 1e3
+    assert row["est_comm_ms"] == pytest.approx(
+        dcn_ms + dense_ici / (cost.ici_gbps * 1e9) * 1e3, rel=0.05)
+
+
+def test_summary_and_split():
+    net = Net(zoo.lenet(with_accuracy=False), phase="TRAIN",
+              source_shapes=zoo.lenet_shapes(2))
+    table = layer_comm_table(net, CommConfig(), make_mesh())
+    s = comm_summary(table, measured_step_ms=10.0)
+    assert s["total_bytes_per_step"] == sum(
+        r["ici_bytes_per_step"] for r in table.values())
+    assert 0.0 <= s["est_comm_fraction_if_unoverlapped"] <= 1.0
+    assert s["measured_step_ms"] == 10.0
+
+
+def test_stats_yaml_gains_comm_section(tmp_path):
+    from tests.test_runtime import _memory_data, _write_mnistish_prototxt
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.engine import Engine
+
+    solver_path = _write_mnistish_prototxt(tmp_path, max_iter=4)
+    eng = Engine(load_solver(solver_path), memory_data=_memory_data(),
+                 output_dir=str(tmp_path))
+    try:
+        eng.train()
+    finally:
+        eng.close()
+    text = (tmp_path / "stats.yaml").read_text()
+    assert "comm:" in text
+    assert "per_layer:" in text
+    assert "est_comm_fraction_if_unoverlapped:" in text
+    assert "conv1:" in text
+
+
+def test_cli_time_comm_table(tmp_path, capsys):
+    model = tmp_path / "deploy.prototxt"
+    model.write_text("""
+name: "tiny"
+input: "data"
+input_dim: 4 input_dim: 3 input_dim: 8 input_dim: 8
+layers { name: "conv" type: CONVOLUTION bottom: "data" top: "conv"
+  convolution_param { num_output: 4 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layers { name: "fc" type: INNER_PRODUCT bottom: "conv" top: "fc"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layers { name: "silence" type: SILENCE bottom: "fc" }
+""")
+    from poseidon_tpu.runtime.cli import main
+    assert main(["time", "--model", str(model), "--iterations", "2",
+                 "--per_layer", "--comm_devices", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "Comm bytes/step/device over 8 devices" in out
+    assert "vs dense" in out
+    assert "total:" in out

@@ -70,3 +70,28 @@ random_seed: 5
 
     # training actually progressed (loss decreased in the rank-0 log)
     assert "Iteration 10" in logs[0]
+
+
+@pytest.mark.skipif(not os.path.isdir(
+    os.path.join(REPO, "examples/mnist/mnist_test_lmdb")),
+    reason="synthetic MNIST LMDB not generated")
+def test_two_process_cli_test_command(tmp_path):
+    """`test` under 2 processes: each host scores a disjoint shard against a
+    sharded eval step (the round-1 gap: pipelines built without a Shard)."""
+    model = tmp_path / "net.prototxt"
+    # TEST-phase-only view of the lenet train/test net
+    src = open(os.path.join(REPO,
+                            "examples/mnist/lenet_train_test.prototxt")).read()
+    model.write_text(src)
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import launch
+    rc, raw_logs = launch.launch_local(
+        2, 4, _free_port(),
+        ["test", "--model", str(model), "--iterations", "4"],
+        capture=True)
+    logs = [b.decode() for b in raw_logs]
+    assert rc == 0, f"cli test failed:\n{logs[0][-2000:]}\n{logs[1][-2000:]}"
+    # rank 0 prints averaged metrics; rank 1 stays quiet
+    assert "loss:" in logs[0]
+    assert "accuracy:" in logs[0]
+    assert "loss:" not in logs[1]
