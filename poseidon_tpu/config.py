@@ -24,6 +24,11 @@ class Policy:
     param_dtype: object = jnp.float32
     compute_dtype: object = jnp.float32  # flipped to bfloat16 by perf configs
     accum_dtype: object = jnp.float32
+    # Internal conv layout. The external/prototxt contract is always NCHW
+    # (Caffe blobs); "NHWC" transposes around each conv so XLA sees the
+    # TPU-preferred channels-last layout — the transposes sit at op
+    # boundaries where XLA's layout assignment can cancel chains of them.
+    conv_layout: str = "NCHW"
 
 
 _policy = Policy()

@@ -26,7 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import matmul_precision, policy
-from ..ops.attention import attention
+from ..ops.pallas_kernels import maybe_flash_attention
 from ..parallel.sequence import ring_attention
 from ..proto.messages import SolverParameter
 from ..solvers.updates import SolverState, init_state, make_update_fn
@@ -102,7 +102,9 @@ def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
         qkv = qkv.reshape(b, s, 3, cfg.n_heads, d_head)
         q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))  # (B,H,S,Dh)
         if seq_axis is None:
-            att = attention(q, k, v, causal=True)
+            # Pallas flash kernel when the sequence tiles cleanly (O(S)
+            # memory, never materializes S x S scores in HBM)
+            att = maybe_flash_attention(q, k, v, causal=True)
         else:
             att = ring_attention(q, k, v, seq_axis, causal=True)
         att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)

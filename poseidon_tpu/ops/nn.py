@@ -48,13 +48,34 @@ def conv2d(
     pad: Tuple[int, int],
     group: int = 1,
 ) -> jax.Array:
-    """NCHW convolution; w is OIHW with I = C/group."""
+    """NCHW convolution; w is OIHW with I = C/group.
+
+    With ``policy().conv_layout == "NHWC"`` the conv itself runs
+    channels-last (TPU-preferred): inputs/outputs transpose at the op
+    boundary, where XLA layout assignment cancels back-to-back transposes
+    between consecutive convs/pools. Interface and results stay NCHW."""
     p = policy()
+    xc = x.astype(p.compute_dtype)
+    wc = w.astype(p.compute_dtype)
+    padding = [(pad[0], pad[0]), (pad[1], pad[1])]
+    if p.conv_layout == "NHWC":
+        y = lax.conv_general_dilated(
+            jnp.transpose(xc, (0, 2, 3, 1)),
+            wc,
+            window_strides=stride,
+            padding=padding,
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+            feature_group_count=group,
+            precision=matmul_precision(),
+        )
+        if b is not None:
+            y = y + b.reshape(1, 1, 1, -1).astype(y.dtype)
+        return jnp.transpose(y, (0, 3, 1, 2))
     y = lax.conv_general_dilated(
-        x.astype(p.compute_dtype),
-        w.astype(p.compute_dtype),
+        xc,
+        wc,
         window_strides=stride,
-        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        padding=padding,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=group,
         precision=matmul_precision(),

@@ -27,7 +27,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import NEG_INF, attention
+from .attention import NEG_INF
 
 
 def _interpret_default() -> bool:
@@ -42,12 +42,23 @@ def _cdiv(a: int, b: int) -> int:
 # Flash attention
 # --------------------------------------------------------------------------- #
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _causal_mask(s, qi, kj, block_q, block_k):
+    rows = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = kj * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *,
                       scale: float, causal: bool, block_q: int, block_k: int,
                       n_kb: int):
     """Grid (bh, q_blocks, k_blocks); only one (block_q, d) Q tile and one
     (block_k, d) K/V tile are VMEM-resident at a time. The online-softmax
-    state persists in scratch across the innermost (k-block) grid dimension."""
+    state persists in scratch across the innermost (k-block) grid dimension.
+    Also emits the per-row logsumexp, which the O(S)-memory backward kernels
+    consume (flash attention paper's L = m + log l)."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -68,11 +79,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kj * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = _causal_mask(s, qi, kj, block_q, block_k)
         m_prev = m_ref[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         alpha = jnp.exp(m_prev - m_new)
@@ -85,8 +92,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     @pl.when(kj == n_kb - 1)
     def _finalize():
         l = l_ref[:, 0]
-        l = jnp.where(l == 0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        lsafe = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / lsafe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(lsafe))[None]
+
+
+def _check_blocks(s, block_q, block_k):
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must divide by blocks "
+                         f"({block_q}, {block_k})")
+    return block_q, block_k
 
 
 def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
@@ -96,17 +113,14 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
     q3 = q.reshape(bh, s, d)
     k3 = k.reshape(bh, s, d)
     v3 = v.reshape(bh, s, d)
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(f"seq len {s} must divide by blocks "
-                         f"({block_q}, {block_k})")
+    block_q, block_k = _check_blocks(s, block_q, block_k)
     n_kb = s // block_k
     grid = (bh, s // block_q, n_kb)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, n_kb=n_kb),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, 1, s), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
@@ -116,8 +130,12 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
             pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -127,7 +145,150 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse.reshape(b, h, s)
+
+
+# --------------------------------------------------------------------------- #
+# Flash attention backward: O(S) memory, two sweeps (flash attention paper)
+# --------------------------------------------------------------------------- #
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                     dq_acc, *, scale: float, causal: bool, block_q: int,
+                     block_k: int, n_kb: int):
+    """Grid (bh, q_blocks, k_blocks): accumulate dQ for one Q tile across all
+    K/V tiles. p is recomputed from Q,K and the saved logsumexp — the score
+    matrix never exists outside one VMEM tile."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    block_live = True if not causal else (kj * block_k
+                                          <= qi * block_q + block_q - 1)
+
+    @pl.when(block_live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                       # (block_q,)
+        delta = delta_ref[0, 0]                   # (block_q,)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])             # masked entries -> 0
+        dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] = dq_acc[:] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                      causal: bool, block_q: int, block_k: int, n_qb: int):
+    """Grid (bh, k_blocks, q_blocks): accumulate dK and dV for one K/V tile
+    across all Q tiles."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    block_live = True if not causal else (qi * block_q + block_q - 1
+                                          >= kj * block_k)
+
+    @pl.when(block_live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])             # (block_q, block_k)
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            p.T, g, preferred_element_type=jnp.float32)
+        dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[:] = dk_acc[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, scale: float, causal: bool,
+               block_q: int, block_k: int, interpret: bool):
+    b, h, s, d = q.shape
+    bh = b * h
+    block_q, block_k = _check_blocks(s, block_q, block_k)
+    n_qb, n_kb = s // block_q, s // block_k
+    # delta_i = rowsum(dO * O): one O(S*D) elementwise pass, fused by XLA
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                       # (b, h, s)
+    r3 = lambda x: x.reshape(bh, s, x.shape[-1])
+    q3, k3, v3, g3 = r3(q), r3(k), r3(v), r3(g)
+    lse3 = lse.reshape(bh, 1, s)
+    delta3 = delta.reshape(bh, 1, s)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM)
+    rowq = pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j),
+                        memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_kb=n_kb),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=(bh, n_qb, n_kb),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse3, delta3)
+
+    # swapped grid: (bh, k_blocks, q_blocks)
+    qspec_t = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0),
+                           memory_space=pltpu.VMEM)
+    kspec_t = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0),
+                           memory_space=pltpu.VMEM)
+    rowq_t = pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, kk),
+                          memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_qb=n_qb),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
+        grid=(bh, n_kb, n_qb),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowq_t, rowq_t],
+        out_specs=(kspec_t, kspec_t),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse3, delta3)
+
+    rs = lambda x: x.reshape(b, h, s, d)
+    return rs(dq), rs(dk), rs(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -139,23 +300,47 @@ def flash_attention(q, k, v, causal: bool = False,
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = _interpret_default()
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention(q_, k_, v_, causal=causal, scale=scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+                      interpret)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def maybe_flash_attention(q, k, v, causal: bool = False,
+                          scale: Optional[float] = None) -> jax.Array:
+    """Route through the Pallas flash kernel when shapes tile cleanly
+    (seq divisible by a 128/256-row block, self-attention layout), else fall
+    back to the dense reference op. The training entry point for
+    models/transformer.py and the Ulysses head-parallel path."""
+    from .attention import attention
+    s = q.shape[-2]
+    same_len = k.shape[-2] == s
+    block = next((bs for bs in (128, 64, 32) if s % bs == 0), None)
+    # off-TPU the kernel would run in interpret-mode emulation — strictly
+    # slower than the dense op it replaces, so only route on real hardware
+    if same_len and block is not None and not _interpret_default():
+        return flash_attention(q, k, v, causal, scale, block, block)
+    return attention(q, k, v, causal=causal, scale=scale)
 
 
 # --------------------------------------------------------------------------- #

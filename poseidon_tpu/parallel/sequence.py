@@ -93,5 +93,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis: str,
         return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attention(qh, kh, vh, causal=causal, scale=scale)
+    # full-sequence attention on the local head group: Pallas flash kernel
+    # when the global sequence tiles cleanly, dense fallback otherwise
+    from ..ops.pallas_kernels import maybe_flash_attention
+    out = maybe_flash_attention(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(out)

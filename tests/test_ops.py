@@ -41,6 +41,31 @@ def test_conv_matches_caffe(rng_np, group):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("group", [1, 2])
+def test_conv_nhwc_layout_matches_nchw(rng_np, group):
+    """Internal NHWC (TPU-preferred) layout: same interface, same numbers,
+    forward and backward."""
+    import jax
+    from poseidon_tpu.config import policy_scope
+    x = rng_np.randn(2, 4, 9, 9).astype(np.float32)
+    w = rng_np.randn(6, 4 // group, 3, 3).astype(np.float32)
+    b = rng_np.randn(6).astype(np.float32)
+
+    def loss(args, *, _g=group):
+        xx, ww, bb = args
+        return NN.conv2d(xx, ww, bb, (2, 2), (1, 1), _g).sum()
+
+    y1 = np.asarray(NN.conv2d(x, w, b, (2, 2), (1, 1), group))
+    g1 = jax.grad(loss)((x, w, b))
+    with policy_scope(conv_layout="NHWC"):
+        y2 = np.asarray(NN.conv2d(x, w, b, (2, 2), (1, 1), group))
+        g2 = jax.grad(loss)((x, w, b))
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-5)
+    for a1, a2, name in zip(g1, g2, "xwb"):
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
 def test_lrn_across_channels(rng_np):
     x = rng_np.randn(2, 8, 5, 5).astype(np.float32)
     got = np.asarray(NN.lrn_across_channels(x, 5, 1e-4, 0.75))

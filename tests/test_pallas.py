@@ -28,20 +28,46 @@ def test_flash_attention_matches_reference(qkv, causal):
                                rtol=2e-4, atol=2e-5)
 
 
-def test_flash_attention_gradients(qkv):
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("blocks", [(32, 32), (32, 64), (128, 128)])
+def test_flash_attention_gradients(qkv, causal, blocks):
+    """The Pallas dq/dk/dv kernels (O(S) memory, recompute-from-lse) against
+    the dense reference VJP, across block shapes incl. full-sequence tiles."""
     q, k, v = qkv
+    bq, bk = blocks
 
     def loss_ref(q, k, v):
-        return jnp.sum(attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(attention(q, k, v, causal=causal) ** 2)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, True, None, 32, 32) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal, None, bq, bk) ** 2)
 
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     for a, b, name in zip(gr, gf, "qkv"):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-3, atol=5e-4, err_msg=name)
+
+
+def test_flash_attention_grad_under_jit_and_vmapless_batch(qkv):
+    """Backward works inside jit (the training-path usage)."""
+    q, k, v = qkv
+    f = jax.jit(jax.grad(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, True, None, 32, 32)
+        .sum(), argnums=(0, 1, 2)))
+    gq, gk, gv = f(q, k, v)
+    for g in (gq, gk, gv):
+        assert np.isfinite(np.asarray(g)).all()
+
+
+def test_maybe_flash_falls_back_off_tpu(qkv):
+    """Off-TPU routing must use the dense op (interpret-mode Pallas would be
+    an emulation slowdown), bit-identical to attention()."""
+    from poseidon_tpu.ops.pallas_kernels import maybe_flash_attention
+    q, k, v = qkv
+    got = maybe_flash_attention(q, k, v, causal=True)
+    want = attention(q, k, v, causal=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_lrn_fused_matches_reference():
