@@ -1,0 +1,113 @@
+"""Fetch REAL CIFAR-10 and build the Caffe-layout LMDBs + mean file.
+
+The in-repo `cifar10_{train,test}_lmdb` are synthetic stand-ins (built by
+examples/make_synthetic_db.py) so tests run hermetically. On a machine with
+network access, this script reproduces the reference's real-data pipeline
+(`/root/reference/examples/cifar10/`: get_cifar10.sh + convert_cifar_data.cpp
++ compute_image_mean) deterministically:
+
+    python examples/cifar10/fetch_real_cifar10.py [--dest examples/cifar10]
+
+then train the quick config and compare against the reference's recorded
+curves (`/root/reference/examples/cifar10/stat.md`: quick solver reaches
+~0.71-0.75 test accuracy at 4-5k iters):
+
+    python -m poseidon_tpu train \
+        --solver=examples/cifar10/cifar10_quick_solver.prototxt
+
+Download integrity is pinned by the MD5 the dataset page itself publishes
+(https://www.cs.toronto.edu/~kriz/cifar.html lists
+c32a1d4ab5d03f1284b67883e8d87530 for cifar-10-binary.tar.gz), record order is
+the upstream batch order, and the LMDB key/Datum layout matches
+convert_cifar_data.cpp (zero-padded running index -> Datum{3x32x32, label}).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import sys
+import tarfile
+import urllib.request
+
+import numpy as np
+
+URL = "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
+MD5 = "c32a1d4ab5d03f1284b67883e8d87530"  # published on the dataset page
+TRAIN_BATCHES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+TEST_BATCHES = ["test_batch.bin"]
+REC = 1 + 3072  # label byte + 3x32x32 pixels
+
+
+def _download(dest: str) -> str:
+    path = os.path.join(dest, "cifar-10-binary.tar.gz")
+    if not os.path.exists(path):
+        print(f"downloading {URL} ...", flush=True)
+        urllib.request.urlretrieve(URL, path)
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    if h.hexdigest() != MD5:
+        raise SystemExit(
+            f"checksum mismatch for {path}:\n  got  {h.hexdigest()}\n"
+            f"  want {MD5}\n(delete the file and retry)")
+    return path
+
+
+def _records(tar: tarfile.TarFile, names):
+    for name in names:
+        member = next(m for m in tar.getmembers()
+                      if os.path.basename(m.name) == name)
+        buf = tar.extractfile(member).read()
+        assert len(buf) % REC == 0, name
+        for i in range(len(buf) // REC):
+            rec = buf[i * REC:(i + 1) * REC]
+            label = rec[0]
+            img = np.frombuffer(rec[1:], np.uint8).reshape(3, 32, 32)
+            yield label, img
+
+
+def _write_lmdb(tar, names, out_path: str) -> int:
+    from poseidon_tpu.data.lmdb_reader import LMDBWriter
+    from poseidon_tpu.proto.wire import Datum, encode_datum
+
+    w = LMDBWriter(out_path)
+    n = 0
+    for label, img in _records(tar, names):
+        d = Datum(channels=3, height=32, width=32, data=img.tobytes(),
+                  label=int(label))
+        # convert_cifar_data.cpp keys: zero-padded running index
+        w.put(f"{n:05d}".encode(), encode_datum(d))
+        n += 1
+    w.close()
+    print(f"{out_path}: {n} records")
+    return n
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dest", default=os.path.dirname(os.path.abspath(__file__)))
+    args = ap.parse_args()
+    sys.path.insert(0, os.path.join(args.dest, "..", ".."))
+
+    tgz = _download(args.dest)
+    train_db = os.path.join(args.dest, "cifar10_train_lmdb")
+    test_db = os.path.join(args.dest, "cifar10_test_lmdb")
+    for p in (train_db, test_db):
+        if os.path.exists(p):
+            raise SystemExit(f"{p} already exists — move the synthetic DB "
+                             f"aside first (it is a test fixture)")
+    with tarfile.open(tgz, "r:gz") as tar:
+        assert _write_lmdb(tar, TRAIN_BATCHES, train_db) == 50000
+        assert _write_lmdb(tar, TEST_BATCHES, test_db) == 10000
+
+    from poseidon_tpu.runtime.tools import compute_image_mean
+    compute_image_mean(train_db, os.path.join(args.dest, "mean.binaryproto"))
+    print("done — train with:\n  python -m poseidon_tpu train "
+          "--solver=examples/cifar10/cifar10_quick_solver.prototxt")
+
+
+if __name__ == "__main__":
+    main()

@@ -502,9 +502,36 @@ def _build_net(node: Node) -> NetParameter:
             layer_nodes.append(v)
         else:
             clean.add(k, v)
+    from .upgrade_v0 import net_needs_v0_upgrade, upgrade_v0_layers
+    if net_needs_v0_upgrade(layer_nodes):
+        layer_nodes = upgrade_v0_layers(layer_nodes)
     net = build(NetParameter, clean)
     net.layers = [_build_layer(n) for n in layer_nodes]
+    for lp in net.layers:
+        _upgrade_data_transform(lp)
     return net
+
+
+def _upgrade_data_transform(lp: LayerParameter) -> None:
+    """NetNeedsDataUpgrade/UpgradeNetDataTransformation: early V1 nets put
+    scale/mean_file/crop_size/mirror inside the data-layer params; the
+    pipeline reads transform_param, so migrate them (explicit
+    transform_param fields win)."""
+    src = {"DATA": lp.data_param, "IMAGE_DATA": lp.image_data_param,
+           "WINDOW_DATA": lp.window_data_param}.get(
+               lp.type if lp.type in V1_TYPES
+               else V2_TYPE_TO_V1.get(lp.type, ""))
+    if src is None:
+        return
+    t = lp.transform_param
+    if getattr(src, "scale", 1.0) != 1.0 and t.scale == 1.0:
+        t.scale = src.scale
+    if getattr(src, "mean_file", "") and not t.mean_file:
+        t.mean_file = src.mean_file
+    if getattr(src, "crop_size", 0) and not t.crop_size:
+        t.crop_size = src.crop_size
+    if getattr(src, "mirror", False) and not t.mirror:
+        t.mirror = src.mirror
 
 
 @dataclass

@@ -86,6 +86,43 @@ def test_deploy_net_with_input_decl(rng_np):
         np.asarray(out.outputs["prob"]).sum(axis=1), 1.0, rtol=1e-5)
 
 
+def test_googlenet_trains_multidevice():
+    """GoogLeNet end-to-end on the 8-device mesh: aux heads (0.3 loss
+    weights, train_test.prototxt parity) contribute to the total loss and
+    all three heads report; one SGD step moves the deepest inception params.
+    bf16 compute keeps the 224x224 CPU run tractable."""
+    import jax
+    from poseidon_tpu.config import policy_scope
+    from poseidon_tpu.parallel import (CommConfig, build_train_step,
+                                       init_train_state, make_mesh)
+    from poseidon_tpu.proto.messages import SolverParameter
+
+    net = Net(zoo.googlenet(num_classes=16), phase="TRAIN",
+              source_shapes=zoo.googlenet_shapes(1))
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    mesh = make_mesh()
+    with policy_scope(compute_dtype=jnp.bfloat16):
+        ts = build_train_step(net, sp, mesh, CommConfig(), donate=False)
+        params = net.init(jax.random.PRNGKey(0))
+        w0 = np.asarray(params["inception_5b/1x1"]["w"])
+        rs = np.random.RandomState(0)
+        batch = {
+            "data": jnp.asarray(rs.rand(8, 3, 224, 224).astype(np.float32)),
+            "label": jnp.asarray(rs.randint(0, 16, size=(8,))),
+        }
+        p, s, m = ts.step(params, init_train_state(params), batch,
+                          jax.random.PRNGKey(1))
+    # total loss = main + 0.3*aux1 + 0.3*aux2 (all finite, all reported)
+    assert np.isfinite(float(m["loss"]))
+    assert {"loss1/loss", "loss2/loss", "loss3"} <= set(m), sorted(m)
+    want = (float(m["loss3"]) + 0.3 * float(m["loss1/loss"])
+            + 0.3 * float(m["loss2/loss"]))
+    assert float(m["loss"]) == pytest.approx(want, rel=0.05)
+    # ~ln(16) at init
+    assert float(m["loss3"]) == pytest.approx(np.log(16), rel=0.4)
+    assert np.abs(np.asarray(p["inception_5b/1x1"]["w"]) - w0).max() > 0
+
+
 def test_googlenet_builds():
     net = Net(zoo.googlenet(num_classes=100), phase="TRAIN",
               source_shapes=zoo.googlenet_shapes(2))

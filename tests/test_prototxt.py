@@ -153,3 +153,116 @@ def test_to_prototxt_roundtrip():
         assert 'type: CONVOLUTION' in text
         assert 'type: "CONVOLUTION"' not in text
     assert 'pool: AVE' in text  # googlenet's non-default pooling survives
+
+
+# --------------------------------------------------------------------------- #
+# V0 legacy format upgrade (upgrade_proto.cpp:15-506)
+# --------------------------------------------------------------------------- #
+
+V0_NET = """
+name: "V0Net"
+layers {
+  layer {
+    name: "mnist" type: "data" source: "train_db" batchsize: 8
+    scale: 0.00390625 cropsize: 24 mirror: true meanfile: "mean.bp"
+  }
+  top: "data" top: "label"
+}
+layers {
+  layer { name: "pad1" type: "padding" pad: 2 }
+  bottom: "data" top: "pad1"
+}
+layers {
+  layer {
+    name: "conv1" type: "conv" num_output: 6 kernelsize: 5 stride: 1
+    group: 2 biasterm: true
+    weight_filler { type: "xavier" }
+    blobs_lr: 1.0 blobs_lr: 2.0 weight_decay: 1.0 weight_decay: 0.0
+  }
+  bottom: "pad1" top: "conv1"
+}
+layers { layer { name: "relu1" type: "relu" } bottom: "conv1" top: "conv1" }
+layers {
+  layer { name: "pool1" type: "pool" pool: MAX kernelsize: 2 stride: 2 }
+  bottom: "conv1" top: "pool1"
+}
+layers {
+  layer { name: "drop" type: "dropout" dropout_ratio: 0.3 }
+  bottom: "pool1" top: "pool1"
+}
+layers {
+  layer { name: "norm" type: "lrn" local_size: 3 alpha: 0.0001 beta: 0.5 }
+  bottom: "pool1" top: "norm"
+}
+layers {
+  layer { name: "ip1" type: "innerproduct" num_output: 10
+          weight_filler { type: "gaussian" std: 0.01 } }
+  bottom: "norm" top: "ip1"
+}
+layers {
+  layer { name: "loss" type: "softmax_loss" }
+  bottom: "ip1" bottom: "label" top: "loss"
+}
+"""
+
+
+def test_v0_net_upgrades():
+    net = load_net_from_string(V0_NET)
+    types = [l.type for l in net.layers]
+    # padding layer is deleted, its pad folded into conv1
+    assert "padding" not in " ".join(types)
+    assert types == ["DATA", "CONVOLUTION", "RELU", "POOLING", "DROPOUT",
+                     "LRN", "INNER_PRODUCT", "SOFTMAX_LOSS"]
+    conv = net.layers[1]
+    assert conv.name == "conv1"
+    assert conv.bottom == ["data"]          # rewired past the padding layer
+    assert conv.convolution_param.pad == 2  # folded from the padding layer
+    assert conv.convolution_param.num_output == 6
+    assert conv.convolution_param.kernel_size == 5
+    assert conv.convolution_param.group == 2
+    assert conv.convolution_param.weight_filler.type == "xavier"
+    assert conv.blobs_lr == [1.0, 2.0]
+    assert conv.weight_decay == [1.0, 0.0]
+    data = net.layers[0]
+    assert data.data_param.source == "train_db"
+    assert data.data_param.batch_size == 8
+    # V0 scale/cropsize/mirror/meanfile land in transform_param
+    assert data.transform_param.scale == pytest.approx(0.00390625)
+    assert data.transform_param.crop_size == 24
+    assert data.transform_param.mirror is True
+    assert data.transform_param.mean_file == "mean.bp"
+    pool = net.layers[3]
+    assert pool.pooling_param.pool == "MAX"
+    assert pool.pooling_param.kernel_size == 2
+    assert net.layers[4].dropout_param.dropout_ratio == pytest.approx(0.3)
+    assert net.layers[5].lrn_param.local_size == 3
+    assert net.layers[6].inner_product_param.num_output == 10
+    # the upgraded net must actually build and run shape inference
+    from poseidon_tpu.core.net import Net
+    built = Net(net, "TRAIN", source_shapes={"data": (8, 2, 24, 24),
+                                             "label": (8,)})
+    assert built.blob_shapes["conv1"] == (8, 6, 24, 24)
+
+
+def test_v0_unknown_field_raises():
+    from poseidon_tpu.proto.prototxt import PrototxtError
+    bad = """
+    layers { layer { name: "x" type: "conv" num_output: 2 bogus_field: 1 }
+             bottom: "data" top: "x" }
+    """
+    with pytest.raises(PrototxtError, match="bogus_field"):
+        load_net_from_string(bad)
+
+
+def test_v1_data_transform_migration():
+    net = load_net_from_string("""
+    layers {
+      name: "d" type: DATA top: "data" top: "label"
+      data_param { source: "db" batch_size: 4 scale: 0.5 crop_size: 12
+                   mirror: true }
+    }
+    layers { name: "s" type: SILENCE bottom: "data" }
+    layers { name: "s2" type: SILENCE bottom: "label" }
+    """)
+    t = net.layers[0].transform_param
+    assert t.scale == 0.5 and t.crop_size == 12 and t.mirror is True
