@@ -77,10 +77,12 @@ def relu(name: str, blob: str) -> LayerParameter:
 
 
 def lrn(name: str, bottom: str, top: str, local_size: int = 5,
-        alpha: float = 1e-4, beta: float = 0.75) -> LayerParameter:
+        alpha: float = 1e-4, beta: float = 0.75,
+        norm_region: str = "ACROSS_CHANNELS") -> LayerParameter:
     return LayerParameter(
         name=name, type="LRN", bottom=[bottom], top=[top],
-        lrn_param=LRNParameter(local_size=local_size, alpha=alpha, beta=beta))
+        lrn_param=LRNParameter(local_size=local_size, alpha=alpha, beta=beta,
+                               norm_region=norm_region))
 
 
 def dropout(name: str, blob: str, ratio: float = 0.5) -> LayerParameter:
@@ -148,6 +150,35 @@ def cifar10_quick(with_accuracy: bool = True) -> NetParameter:
     if with_accuracy:
         layers.insert(-1, accuracy("accuracy", ["ip2", "label"]))
     return NetParameter(name="CIFAR10_quick", layers=layers)
+
+
+def cifar10_full(with_accuracy: bool = True) -> NetParameter:
+    """examples/cifar10/cifar10_full_train_test.prototxt: the deeper CIFAR
+    config — pool-before-relu stem, WITHIN_CHANNEL LRNs, heavy ip decay."""
+    layers = [
+        conv("conv1", "data", "conv1", 32, 5, pad=2,
+             weight_filler=gaussian(1e-4)),
+        pool("pool1", "conv1", "pool1", "MAX", 3, 2),
+        relu("relu1", "pool1"),
+        lrn("norm1", "pool1", "norm1", local_size=3, alpha=5e-5, beta=0.75,
+            norm_region="WITHIN_CHANNEL"),
+        conv("conv2", "norm1", "conv2", 32, 5, pad=2,
+             weight_filler=gaussian(0.01)),
+        relu("relu2", "conv2"),
+        pool("pool2", "conv2", "pool2", "AVE", 3, 2),
+        lrn("norm2", "pool2", "norm2", local_size=3, alpha=5e-5, beta=0.75,
+            norm_region="WITHIN_CHANNEL"),
+        conv("conv3", "norm2", "conv3", 64, 5, pad=2,
+             weight_filler=gaussian(0.01), lr=(1, 1), decay=(1, 0)),
+        relu("relu3", "conv3"),
+        pool("pool3", "conv3", "pool3", "AVE", 3, 2),
+        ip("ip1", "pool3", "ip1", 10, weight_filler=gaussian(0.01),
+           decay=(250.0, 0.0)),
+        softmax_loss("loss", ["ip1", "label"]),
+    ]
+    if with_accuracy:
+        layers.insert(-1, accuracy("accuracy", ["ip1", "label"]))
+    return NetParameter(name="CIFAR10_full", layers=layers)
 
 
 def cifar10_shapes(batch: int) -> Dict[str, tuple]:

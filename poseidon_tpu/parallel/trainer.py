@@ -109,6 +109,7 @@ def build_train_step(
     mesh: Mesh,
     comm: Optional[CommConfig] = None,
     donate: bool = True,
+    dump_blobs: Optional[list] = None,
 ) -> TrainStep:
     """Compiled SPMD train step over ``mesh``.
 
@@ -118,7 +119,11 @@ def build_train_step(
     magnitude top-k compressed exchange *between* slices over the slow DCN
     axis with per-slice error feedback — the SSPAggr analog
     (ssp_aggr_server_thread.cpp: full-rate intra-machine, budgeted
-    prioritized bytes inter-machine)."""
+    prioritized bytes inter-machine).
+
+    ``dump_blobs`` (HDF5_OUTPUT-in-TRAIN support, hdf5_output_layer.cpp):
+    the step additionally returns those activation blobs, batch-sharded —
+    the fourth element of the step's result tuple."""
     comm = comm or CommConfig()
     axis = comm.axis
     dcn = comm.dcn_axis
@@ -142,6 +147,12 @@ def build_train_step(
     topk_fraction = budget_topk_fraction(net, comm)
     batch_spec = P(axes) if dcn else P(axis)
     err_spec = P(dcn) if dcn else P(axis)
+    for b in (dump_blobs or ()):
+        if len(net.blob_shapes.get(b, ())) < 1:
+            raise ValueError(
+                f"HDF5_OUTPUT bottom {b!r} is a scalar: per-sample dumping "
+                f"needs a batch dimension (hdf5_output_layer.cpp requires "
+                f"num()-shaped bottoms)")
 
     def device_step(params, state: TrainState, batch, rng):
         flat_idx = lax.axis_index(axis)
@@ -150,7 +161,8 @@ def build_train_step(
         rng = jax.random.fold_in(rng, flat_idx)
 
         def loss_fn(p):
-            out = net.apply(p, batch, train=True, rng=rng, comm=ctx)
+            out = net.apply(p, batch, train=True, rng=rng, comm=ctx,
+                            keep_blobs=bool(dump_blobs))
             return out.loss, out
 
         grads, out = jax.grad(loss_fn, has_aux=True)(params)
@@ -187,16 +199,22 @@ def build_train_step(
             if val.ndim == 0:
                 metrics[name] = lax.psum(val.astype(jnp.float32),
                                          axes) / n_total
-        return new_params, TrainState(new_solver, new_errors), metrics
+        dumps = {b: out.blobs[b] for b in (dump_blobs or ())}
+        return new_params, TrainState(new_solver, new_errors), metrics, dumps
 
     sharded = jax.shard_map(
         device_step,
         mesh=mesh,
         in_specs=(P(), TrainState(P(), err_spec), batch_spec, P()),
-        out_specs=(P(), TrainState(P(), err_spec), P()),
+        out_specs=(P(), TrainState(P(), err_spec), P(), batch_spec),
         check_vma=False,
     )
-    step = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+    jitted = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+    if dump_blobs:
+        step = jitted
+    else:
+        # callers without dumps keep the 3-tuple contract
+        step = lambda p, s, b, r: jitted(p, s, b, r)[:3]  # noqa: E731
     return TrainStep(
         step=step,
         mesh=mesh,

@@ -63,6 +63,10 @@ def _engine_from_args(args, phase_nets=True):
 
 def cmd_train(args) -> int:
     from .cluster import init_distributed
+    if args.bf16:
+        import jax.numpy as jnp
+        from .. import config
+        config.set_policy(compute_dtype=jnp.bfloat16)
     init_distributed(hostfile=args.hostfile or None,
                      node_id=args.node_id if args.node_id >= 0 else None)
     eng = _engine_from_args(args)
@@ -353,6 +357,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sfb-auto", action="store_true",
                    help="pick SFB per FC layer by cost model (SACP)")
     t.add_argument("--grad-reduce", default="mean", choices=["mean", "sum"])
+    t.add_argument("--bf16", action="store_true",
+                   help="bfloat16 compute (MXU-native); params/updates stay "
+                        "f32. Default f32 matches Caffe numerics exactly")
     t.add_argument("--dcn_slices", type=int, default=0,
                    help="split devices into N slices on a slow (DCN) mesh "
                         "axis: dense sync intra-slice, TOPK-compressed "

@@ -117,6 +117,18 @@ class Engine:
                 self.comm.layer_strategies.update(
                     auto_strategies(self.train_net))
 
+        # HDF5_OUTPUT in the TRAIN net (hdf5_output_layer.cpp): the step
+        # additionally returns the dump bottoms; after every iteration the
+        # file is rewritten with the latest batch — the reference's
+        # overwrite-per-forward semantics. Must be known before step build.
+        self._h5_train = [
+            (l.lp.hdf5_output_param.file_name, list(l.lp.bottom))
+            for l in self.train_net.layers if l.TYPE == "HDF5_OUTPUT"]
+        if self._h5_train and staleness > 0:
+            log("WARNING: HDF5_OUTPUT in the TRAIN net is not dumped "
+                "under SSP staleness", rank=self.rank)
+            self._h5_train = []
+
         # --- compiled steps ---------------------------------------------- #
         if staleness > 0:
             # SSP (ssp_consistency_controller.cpp): each device runs local
@@ -135,8 +147,9 @@ class Engine:
                 batch_sharding=ssp_ts.batch_sharding,
                 replicated=ssp_ts.replicated)
         else:
+            dump = sorted({b for _, bs in self._h5_train for b in bs})
             self.train_step = build_train_step(self.train_net, sp, self.mesh,
-                                               self.comm)
+                                               self.comm, dump_blobs=dump)
         self.eval_steps = [
             build_eval_step(n, self.mesh, dcn_axis=self.comm.dcn_axis)
             for n in self.test_nets]
@@ -156,11 +169,6 @@ class Engine:
                              for i in range(len(self.test_nets))]
         self.profile_steps = 0  # set >0 to capture an xplane trace
 
-        # HDF5_OUTPUT layers (hdf5_output_layer.cpp): dump their bottoms
-        # during test passes; side-effecting IO stays outside the traced step.
-        if any(l.TYPE == "HDF5_OUTPUT" for l in self.train_net.layers):
-            log("WARNING: HDF5_OUTPUT in the TRAIN net is not dumped "
-                "(supported in TEST nets only)", rank=self.rank)
         self._h5_outputs = [
             [(l.lp.hdf5_output_param.file_name, list(l.lp.bottom))
              for l in net.layers if l.TYPE == "HDF5_OUTPUT"]
@@ -270,6 +278,7 @@ class Engine:
         it = self.iteration()
         t_start = time.time()
         last: Dict[str, float] = {}
+        pending: List[Dict] = []  # un-materialized device metrics
         # profiler window: skip a couple of warmup/compile steps
         profile_start = it + 2
         profiling = False
@@ -288,8 +297,13 @@ class Engine:
                 profiling = True
             batch = self._next_batch(self.train_pipelines)
             t0 = time.time()
-            self.params, self.state, m = self.train_step.step(
+            result = self.train_step.step(
                 self.params, self.state, batch, jax.random.fold_in(self.rng, it))
+            if self._h5_train:
+                self.params, self.state, m, dumps = result
+                self._write_train_h5(dumps)
+            else:
+                self.params, self.state, m = result
             it += 1
             if profiling and it >= profile_start + self.profile_steps:
                 jax.block_until_ready(m["loss"])
@@ -298,12 +312,27 @@ class Engine:
                 log(f"Wrote profiler trace to "
                     f"{os.path.join(self.output_dir, 'profile')}",
                     rank=self.rank)
-            last = {k: float(v) for k, v in m.items()}
-            self.metrics.accumulate(last)
+            # keep metrics as device arrays: float() here would block the
+            # host on every step and serialize the async dispatch pipeline;
+            # values materialize only at display boundaries
+            pending.append(m)
             self.stats.add("train_iters")
             self.stats.add_time("train_step", time.time() - t0)
 
+            if not sp.display and len(pending) >= 64:
+                # no display cadence configured: flush periodically so the
+                # window never pins unbounded live device buffers
+                for pm in pending:
+                    self.metrics.accumulate(
+                        {k: float(v) for k, v in pm.items()})
+                last = {k: float(v) for k, v in pending[-1].items()}
+                pending = []
             if sp.display and it % sp.display == 0:
+                for pm in pending:
+                    self.metrics.accumulate(
+                        {k: float(v) for k, v in pm.items()})
+                last = {k: float(v) for k, v in pending[-1].items()}
+                pending = []
                 row = self.metrics.flush_row(it)
                 lr = float(learning_rate(sp, jnp.asarray(it - 1)))
                 extras = ", ".join(
@@ -316,6 +345,10 @@ class Engine:
                     self.test(i)
                     self.test_metrics[i].flush_row(it)
 
+        if pending:  # tail iterations past the last display boundary
+            for pm in pending:
+                self.metrics.accumulate({k: float(v) for k, v in pm.items()})
+            last = {k: float(v) for k, v in pending[-1].items()}
         if profiling:
             jax.profiler.stop_trace()
             log(f"Wrote profiler trace to "
@@ -325,6 +358,26 @@ class Engine:
         self.stats.add_time("train_total", time.time() - t_start)
         self._write_artifacts()
         return last
+
+    def _write_train_h5(self, dumps: Dict[str, jax.Array]):
+        """Rewrite each TRAIN-net HDF5_OUTPUT file with the latest batch
+        (hdf5_output_layer.cpp overwrites its datasets every Forward)."""
+        import h5py
+        host = {}
+        multihost = jax.process_count() > 1
+        for k, v in dumps.items():
+            if multihost and not v.is_fully_addressable:
+                from jax.experimental import multihost_utils
+                v = multihost_utils.process_allgather(v, tiled=True)
+            host[k] = np.asarray(v)
+        if self.rank != 0:
+            return
+        for fname, bottoms in self._h5_train:
+            path = os.path.join(self.output_dir, fname)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with h5py.File(path, "w") as f:
+                for b in bottoms:
+                    f.create_dataset(b.replace("/", "_"), data=host[b])
 
     def _write_h5_outputs(self, h5_acc: Dict[str, list]):
         import h5py

@@ -86,6 +86,31 @@ def test_deploy_net_with_input_decl(rng_np):
         np.asarray(out.outputs["prob"]).sum(axis=1), 1.0, rtol=1e-5)
 
 
+def test_cifar10_full_builds_and_steps():
+    """cifar10_full (pool-before-relu, WITHIN_CHANNEL LRN, decay 250 ip):
+    builds, one train step moves params, loss ~ ln(10)."""
+    import jax
+    from poseidon_tpu.parallel import (CommConfig, build_train_step,
+                                       init_train_state, make_mesh)
+    from poseidon_tpu.proto.messages import SolverParameter
+
+    net = Net(zoo.cifar10_full(), phase="TRAIN",
+              source_shapes=zoo.cifar10_shapes(2))
+    assert net.layers[3].lp.lrn_param.norm_region == "WITHIN_CHANNEL"
+    sp = SolverParameter(base_lr=0.001, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.004)
+    ts = build_train_step(net, sp, make_mesh(), CommConfig(), donate=False)
+    params = net.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    batch = {"data": jnp.asarray(rs.rand(16, 3, 32, 32).astype(np.float32)),
+             "label": jnp.asarray(rs.randint(0, 10, size=(16,)))}
+    p, s, m = ts.step(params, init_train_state(params), batch,
+                      jax.random.PRNGKey(1))
+    assert float(m["loss"]) == pytest.approx(np.log(10), rel=0.3)
+    assert np.abs(np.asarray(p["ip1"]["w"]) -
+                  np.asarray(params["ip1"]["w"])).max() > 0
+
+
 def test_googlenet_trains_multidevice():
     """GoogLeNet end-to-end on the 8-device mesh: aux heads (0.3 loss
     weights, train_test.prototxt parity) contribute to the total loss and

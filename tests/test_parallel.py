@@ -156,6 +156,47 @@ def test_topk_sync_keeps_replicas_consistent(mesh, lenet_net, rng_np):
     assert np.abs(w - np.asarray(params["conv1"]["w"])).max() > 0
 
 
+def test_topk_error_feedback_preserves_convergence(mesh, lenet_net, rng_np):
+    """TOPK@10% must land within a modest margin of dense training after N
+    steps — the error-feedback guarantee (delayed, not lost). Also exercises
+    comm_error across snapshot/restore mid-run."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    n_iters = 14
+
+    dense = build_train_step(lenet_net, sp, mesh, CommConfig(), donate=False)
+    p, s = params, init_train_state(params)
+    for i in range(n_iters):
+        p, s, m_dense = dense.step(p, s, batch, jax.random.PRNGKey(i))
+
+    cc = CommConfig(default_strategy="topk", topk_fraction=0.1)
+    ts = build_train_step(lenet_net, sp, mesh, cc, donate=False)
+    p, s = params, init_train_state(params, cc, N_DEV)
+    for i in range(n_iters // 2):
+        p, s, m = ts.step(p, s, batch, jax.random.PRNGKey(i))
+
+    # mid-run snapshot/restore roundtrip must preserve the residuals exactly
+    from poseidon_tpu.runtime.checkpoint import restore, snapshot
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        _, state_path = snapshot(os.path.join(d, "tk"), lenet_net, p, s)
+        p2, s2 = restore(state_path)
+        for l, lp_ in s.comm_error.items():
+            for k in lp_:
+                np.testing.assert_array_equal(
+                    np.asarray(s2.comm_error[l][k]), np.asarray(lp_[k]))
+    for i in range(n_iters // 2, n_iters):
+        p2, s2, m_topk = ts.step(p2, s2, batch, jax.random.PRNGKey(i))
+
+    start = float(np.log(10))
+    d_loss, t_loss = float(m_dense["loss"]), float(m_topk["loss"])
+    assert d_loss < 0.5 * start
+    # within half of dense's progress despite sending only 10% of entries
+    assert t_loss < d_loss + 0.5 * (start - d_loss), \
+        f"topk {t_loss} vs dense {d_loss}"
+
+
 def test_eval_step(mesh, rng_np):
     net = Net(zoo.lenet(with_accuracy=True), phase="TEST",
               source_shapes=zoo.lenet_shapes(BATCH // N_DEV))

@@ -325,3 +325,48 @@ test_initialization: false
     with h5py.File(tmp_path / "features.h5", "r") as f:
         feats = np.asarray(f["feat"])
     assert feats.shape == (3 * 4 * N_DEV, 3)  # test_iter * global batch
+
+
+def test_hdf5_output_during_train(tmp_path):
+    """HDF5_OUTPUT in the TRAIN phase (round-1 gap): after training, the
+    file holds the LAST batch's bottoms — the reference's
+    overwrite-per-forward semantics (hdf5_output_layer.cpp)."""
+    import h5py
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.engine import Engine
+
+    net = tmp_path / "net.prototxt"
+    net.write_text("""
+name: "H5Train"
+layers {
+  name: "src" type: MEMORY_DATA top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 6 width: 6 }
+}
+layers { name: "ip" type: INNER_PRODUCT bottom: "data" top: "feat"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "feat" bottom: "label" top: "loss" }
+layers { name: "dump" type: HDF5_OUTPUT bottom: "feat" bottom: "label"
+  include { phase: TRAIN }
+  hdf5_output_param { file_name: "train_feats.h5" } }
+""")
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f"""
+net: "{net}"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 3
+""")
+    md = {"data": np.random.RandomState(0).rand(64, 1, 6, 6).astype(np.float32),
+          "label": np.arange(64) % 3}
+    eng = Engine(load_solver(str(solver)), memory_data=md,
+                 output_dir=str(tmp_path))
+    try:
+        eng.train()
+    finally:
+        eng.close()
+    with h5py.File(tmp_path / "train_feats.h5", "r") as f:
+        feats = np.asarray(f["feat"])
+        labels = np.asarray(f["label"])
+    # one (latest) global batch, not an accumulation across iterations
+    assert feats.shape == (4 * N_DEV, 3)
+    assert labels.shape == (4 * N_DEV,)
