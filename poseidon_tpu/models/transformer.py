@@ -40,6 +40,10 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 512
     max_seq: int = 1024
+    # rematerialize each block's activations in the backward pass
+    # (jax.checkpoint): HBM drops from O(layers x S x D) stored activations
+    # to O(S x D) per live block — the lever that lets long sequences fit
+    remat: bool = False
 
 
 def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict:
@@ -94,8 +98,7 @@ def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
     x = params["embed"]["w"][tokens]
     positions = pos_offset + jnp.arange(s)
     x = x + params["pos"]["w"][positions]
-    for i in range(len([k for k in params if k.startswith("block")])):
-        blk = params[f"block{i}"]
+    def block(x, blk):
         h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
         qkv = _dense(h, blk["wqkv"])  # (B, S, 3*D)
         d_head = cfg.d_model // cfg.n_heads
@@ -111,7 +114,14 @@ def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
         x = x + _dense(att, blk["wo"]).astype(x.dtype)
         h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
         ff = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
-        x = x + ff.astype(x.dtype)
+        return x + ff.astype(x.dtype)
+
+    if cfg.remat:
+        # policy: keep only each block's input; everything inside (scores,
+        # probabilities, ffn intermediates) recomputes during backward
+        block = jax.checkpoint(block)
+    for i in range(len([k for k in params if k.startswith("block")])):
+        x = block(x, params[f"block{i}"])
     x = _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"])
     return _dense(x, params["head"]["w"]).astype(jnp.float32)
 

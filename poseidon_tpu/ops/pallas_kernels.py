@@ -42,23 +42,38 @@ def _cdiv(a: int, b: int) -> int:
 # Flash attention
 # --------------------------------------------------------------------------- #
 
-def _causal_mask(s, qi, kj, block_q, block_k):
+def _causal_mask(s, qi, kj, block_q, block_k, mode=None):
+    """Self-attention: mask by absolute tile position. Chunked (ring) mode:
+    ``mode`` is a traced scalar describing how the K/V chunk aligns with the
+    Q rows' chunk — +1 chunk strictly past (all live), 0 diagonal (in-chunk
+    triangle), -1 future (all masked)."""
     rows = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = kj * block_k + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(rows >= cols, s, NEG_INF)
+    if mode is None:
+        return jnp.where(rows >= cols, s, NEG_INF)
+    live = (mode > 0) | ((mode == 0) & (rows >= cols))
+    return jnp.where(live, s, NEG_INF)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                      acc_ref, m_ref, l_ref, *,
-                      scale: float, causal: bool, block_q: int, block_k: int,
-                      n_kb: int):
+def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                      block_k: int, n_kb: int, chunk_mode: bool):
     """Grid (bh, q_blocks, k_blocks); only one (block_q, d) Q tile and one
     (block_k, d) K/V tile are VMEM-resident at a time. The online-softmax
     state persists in scratch across the innermost (k-block) grid dimension.
     Also emits the per-row logsumexp, which the O(S)-memory backward kernels
-    consume (flash attention paper's L = m + log l)."""
+    consume (flash attention paper's L = m + log l).
+
+    ``chunk_mode`` (ring attention): a leading SMEM scalar describes the
+    chunk alignment for causal masking (see _causal_mask)."""
+    if chunk_mode:
+        mode_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, \
+            acc_ref, m_ref, l_ref = refs
+        mode = mode_ref[0]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        mode = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -68,9 +83,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: blocks entirely above the diagonal contribute nothing
-    block_live = True if not causal else (kj * block_k
-                                          <= qi * block_q + block_q - 1)
+    # causal self-attention: blocks entirely above the diagonal contribute
+    # nothing (static skip); chunked liveness is dynamic, handled by the mask
+    block_live = True if (not causal or chunk_mode) else \
+        (kj * block_k <= qi * block_q + block_q - 1)
 
     @pl.when(block_live)
     def _update():
@@ -79,7 +95,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, mode)
         m_prev = m_ref[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         alpha = jnp.exp(m_prev - m_new)
@@ -107,7 +123,9 @@ def _check_blocks(s, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
-               block_k: int, interpret: bool):
+               block_k: int, interpret: bool, mode=None):
+    """mode (traced int32 scalar) selects chunked causal masking for ring
+    attention; None = plain self-attention."""
     b, h, s, d = q.shape
     bh = b * h
     q3 = q.reshape(bh, s, d)
@@ -116,20 +134,27 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
     block_q, block_k = _check_blocks(s, block_q, block_k)
     n_kb = s // block_k
     grid = (bh, s // block_q, n_kb)
+    chunk = mode is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q3, k3, v3]
+    if chunk:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.insert(0, jnp.asarray(mode, jnp.int32).reshape(1))
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_kb=n_kb),
+                          block_q=block_q, block_k=block_k, n_kb=n_kb,
+                          chunk_mode=chunk),
         out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
                    jax.ShapeDtypeStruct((bh, 1, s), jnp.float32)),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -144,7 +169,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*args)
     return out.reshape(b, h, s, d), lse.reshape(b, h, s)
 
 
@@ -152,12 +177,18 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
 # Flash attention backward: O(S) memory, two sweeps (flash attention paper)
 # --------------------------------------------------------------------------- #
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-                     dq_acc, *, scale: float, causal: bool, block_q: int,
-                     block_k: int, n_kb: int):
+def _flash_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
+                     block_k: int, n_kb: int, chunk_mode: bool):
     """Grid (bh, q_blocks, k_blocks): accumulate dQ for one Q tile across all
     K/V tiles. p is recomputed from Q,K and the saved logsumexp — the score
     matrix never exists outside one VMEM tile."""
+    if chunk_mode:
+        mode_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, \
+            dq_ref, dq_acc = refs
+        mode = mode_ref[0]
+    else:
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
+        mode = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -165,8 +196,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    block_live = True if not causal else (kj * block_k
-                                          <= qi * block_q + block_q - 1)
+    block_live = True if (not causal or chunk_mode) else \
+        (kj * block_k <= qi * block_q + block_q - 1)
 
     @pl.when(block_live)
     def _update():
@@ -178,7 +209,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0, 0]                   # (block_q,)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, mode)
         p = jnp.exp(s - lse[:, None])             # masked entries -> 0
         dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -190,11 +221,18 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                      causal: bool, block_q: int, block_k: int, n_qb: int):
+def _flash_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
+                      block_k: int, n_qb: int, chunk_mode: bool):
     """Grid (bh, k_blocks, q_blocks): accumulate dK and dV for one K/V tile
     across all Q tiles."""
+    if chunk_mode:
+        mode_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+        mode = mode_ref[0]
+    else:
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+        mode = None
     kj = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -203,8 +241,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    block_live = True if not causal else (qi * block_q + block_q - 1
-                                          >= kj * block_k)
+    block_live = True if (not causal or chunk_mode) else \
+        (qi * block_q + block_q - 1 >= kj * block_k)
 
     @pl.when(block_live)
     def _update():
@@ -216,7 +254,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, mode)
         p = jnp.exp(s - lse[:, None])             # (block_q, block_k)
         dv_acc[:] = dv_acc[:] + jnp.dot(
             p.T, g, preferred_element_type=jnp.float32)
@@ -232,18 +270,25 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, scale: float, causal: bool,
-               block_q: int, block_k: int, interpret: bool):
+               block_q: int, block_k: int, interpret: bool, mode=None,
+               delta=None):
+    """mode: see _flash_fwd. ``delta`` (rowsum(dO*O), global) may be passed
+    in by the ring backward, whose O is the merged global output."""
     b, h, s, d = q.shape
     bh = b * h
     block_q, block_k = _check_blocks(s, block_q, block_k)
     n_qb, n_kb = s // block_q, s // block_k
-    # delta_i = rowsum(dO * O): one O(S*D) elementwise pass, fused by XLA
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                       # (b, h, s)
+    if delta is None:
+        # delta_i = rowsum(dO * O): one O(S*D) elementwise pass, XLA-fused
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)                   # (b, h, s)
     r3 = lambda x: x.reshape(bh, s, x.shape[-1])
     q3, k3, v3, g3 = r3(q), r3(k), r3(v), r3(g)
     lse3 = lse.reshape(bh, 1, s)
     delta3 = delta.reshape(bh, 1, s)
+    chunk = mode is not None
+    mode_arg = [jnp.asarray(mode, jnp.int32).reshape(1)] if chunk else []
+    smem = [pl.BlockSpec(memory_space=pltpu.SMEM)] if chunk else []
 
     qspec = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM)
@@ -254,16 +299,17 @@ def _flash_bwd(q, k, v, out, lse, g, scale: float, causal: bool,
 
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_kb=n_kb),
+                          block_q=block_q, block_k=block_k, n_kb=n_kb,
+                          chunk_mode=chunk),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         grid=(bh, n_qb, n_kb),
-        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        in_specs=smem + [qspec, kspec, kspec, qspec, rowq, rowq],
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3, g3, lse3, delta3)
+    )(*mode_arg, q3, k3, v3, g3, lse3, delta3)
 
     # swapped grid: (bh, k_blocks, q_blocks)
     qspec_t = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0),
@@ -274,18 +320,19 @@ def _flash_bwd(q, k, v, out, lse, g, scale: float, causal: bool,
                           memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_qb=n_qb),
+                          block_q=block_q, block_k=block_k, n_qb=n_qb,
+                          chunk_mode=chunk),
         out_shape=(jax.ShapeDtypeStruct((bh, s, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
         grid=(bh, n_kb, n_qb),
-        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowq_t, rowq_t],
+        in_specs=smem + [qspec_t, kspec_t, kspec_t, qspec_t, rowq_t, rowq_t],
         out_specs=(kspec_t, kspec_t),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3, g3, lse3, delta3)
+    )(*mode_arg, q3, k3, v3, g3, lse3, delta3)
 
     rs = lambda x: x.reshape(b, h, s, d)
     return rs(dq), rs(dk), rs(dv)
@@ -326,16 +373,21 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def pick_block(s: int) -> Optional[int]:
+    """Largest clean tile height for a sequence length, MXU/VPU-aligned."""
+    return next((bs for bs in (128, 64, 32) if s % bs == 0), None)
+
+
 def maybe_flash_attention(q, k, v, causal: bool = False,
                           scale: Optional[float] = None) -> jax.Array:
     """Route through the Pallas flash kernel when shapes tile cleanly
-    (seq divisible by a 128/256-row block, self-attention layout), else fall
-    back to the dense reference op. The training entry point for
+    (seq divisible by a 128/64/32-row block, self-attention layout), else
+    fall back to the dense reference op. The training entry point for
     models/transformer.py and the Ulysses head-parallel path."""
     from .attention import attention
     s = q.shape[-2]
     same_len = k.shape[-2] == s
-    block = next((bs for bs in (128, 64, 32) if s % bs == 0), None)
+    block = pick_block(s)
     # off-TPU the kernel would run in interpret-mode emulation — strictly
     # slower than the dense op it replaces, so only route on real hardware
     if same_len and block is not None and not _interpret_default():

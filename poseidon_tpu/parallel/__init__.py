@@ -8,4 +8,6 @@ from .trainer import (  # noqa: F401
     build_train_step, comm_error_groups, init_comm_error, init_ssp_state,
     init_train_state, param_mults, reconcile_comm_error,
 )
-from .sequence import ring_attention, ulysses_attention  # noqa: F401
+from .sequence import (  # noqa: F401
+    ring_attention, ring_flash_attention, ulysses_attention,
+)

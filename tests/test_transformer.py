@@ -46,6 +46,25 @@ def test_forward_shapes_and_causality():
                            np.asarray(logits2[:, 10:]))
 
 
+def test_remat_gradients_match():
+    """jax.checkpoint per block must not change values or gradients."""
+    import dataclasses
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    rs = np.random.RandomState(1)
+    tokens, targets = _pattern_batch(rs, 2, 16)
+    cfg_r = dataclasses.replace(CFG, remat=True)
+
+    def loss(p, cfg):
+        return lm_loss(forward(p, cfg, tokens), targets)
+
+    l0, g0 = jax.value_and_grad(loss)(params, CFG)
+    l1, g1 = jax.value_and_grad(loss)(params, cfg_r)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7), g0, g1)
+
+
 def test_dp_sp_training_converges(mesh):
     sp = SolverParameter(base_lr=0.1, lr_policy="fixed", momentum=0.9)
     params = init_params(CFG, jax.random.PRNGKey(0))
