@@ -332,4 +332,72 @@ void pdp_close(void* h) {
   delete db;
 }
 
+// Snappy block-format decompressor (public format spec: varint32 length,
+// then literal / copy-1/2/4 elements). The fast path behind the Python
+// codec in poseidon_tpu/data/snappy.py — LevelDB SSTable blocks decompress
+// through this when the library is built.
+//
+// Returns the uncompressed length, or -1 (malformed), or -2 (dst_cap too
+// small; call with dst=null to query the needed size).
+int64_t pdp_snappy_uncompress(const uint8_t* src, int64_t src_len,
+                              uint8_t* dst, int64_t dst_cap) {
+  int64_t pos = 0;
+  uint64_t expected = 0;
+  int shift = 0;
+  for (;;) {  // varint32 uncompressed length
+    if (pos >= src_len || shift > 32) return -1;
+    uint8_t b = src[pos++];
+    expected |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  if (dst == nullptr) return (int64_t)expected;
+  if ((int64_t)expected > dst_cap) return -2;
+  int64_t out = 0;
+  while (pos < src_len) {
+    uint8_t tag = src[pos++];
+    uint32_t elem = tag & 3;
+    if (elem == 0) {  // literal
+      int64_t len = tag >> 2;
+      if (len >= 60) {
+        int extra = (int)len - 59;
+        if (pos + extra > src_len) return -1;
+        len = 0;
+        for (int i = 0; i < extra; ++i) len |= (int64_t)src[pos + i] << (8 * i);
+        pos += extra;
+      }
+      len += 1;
+      if (pos + len > src_len || out + len > (int64_t)expected) return -1;
+      memcpy(dst + out, src + pos, (size_t)len);
+      pos += len;
+      out += len;
+      continue;
+    }
+    int64_t len, offset;
+    if (elem == 1) {  // copy, 1-byte offset
+      len = 4 + ((tag >> 2) & 0x7);
+      if (pos >= src_len) return -1;
+      offset = ((int64_t)(tag >> 5) << 8) | src[pos];
+      pos += 1;
+    } else if (elem == 2) {  // copy, 2-byte offset
+      len = (tag >> 2) + 1;
+      if (pos + 2 > src_len) return -1;
+      offset = (int64_t)src[pos] | ((int64_t)src[pos + 1] << 8);
+      pos += 2;
+    } else {  // copy, 4-byte offset
+      len = (tag >> 2) + 1;
+      if (pos + 4 > src_len) return -1;
+      offset = 0;
+      for (int i = 0; i < 4; ++i) offset |= (int64_t)src[pos + i] << (8 * i);
+      pos += 4;
+    }
+    if (offset <= 0 || offset > out || out + len > (int64_t)expected)
+      return -1;
+    // overlapping copies are byte-serial by definition (RLE-style refs)
+    for (int64_t i = 0; i < len; ++i) dst[out + i] = dst[out - offset + i];
+    out += len;
+  }
+  return out == (int64_t)expected ? out : -1;
+}
+
 }  // extern "C"

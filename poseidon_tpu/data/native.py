@@ -74,12 +74,45 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,
         ]
         lib.pdp_close.argtypes = [ctypes.c_void_p]
+        # newer symbol: a stale prebuilt .so may predate it — the batcher
+        # must keep working, only the snappy fast path degrades
+        if hasattr(lib, "pdp_snappy_uncompress"):
+            lib.pdp_snappy_uncompress.restype = ctypes.c_int64
+            lib.pdp_snappy_uncompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ]
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+# A corrupt header must not force a huge zero-filled allocation before the
+# body is ever validated; LevelDB blocks are ~4-64 KiB, so this is generous.
+_SNAPPY_MAX_OUT = 256 << 20
+
+
+def snappy_uncompress(buf: bytes) -> Optional[bytes]:
+    """Native snappy decode; None when the library is unavailable, raises
+    on malformed input (same contract as the pure-Python codec)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "pdp_snappy_uncompress"):
+        return None
+    need = lib.pdp_snappy_uncompress(buf, len(buf), None, 0)
+    if need < 0:
+        raise ValueError("native snappy: malformed header")
+    if need > _SNAPPY_MAX_OUT:
+        raise ValueError(
+            f"native snappy: declared size {need} exceeds the "
+            f"{_SNAPPY_MAX_OUT}-byte block cap (corrupt header?)")
+    out = (ctypes.c_uint8 * need)()
+    got = lib.pdp_snappy_uncompress(buf, len(buf), out, need)
+    if got != need:
+        raise ValueError(f"native snappy: malformed stream (rc={got})")
+    return bytes(out)
 
 
 class NativeLMDBBatcher:

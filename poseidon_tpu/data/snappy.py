@@ -26,6 +26,20 @@ def _read_varint32(buf: bytes, pos: int):
 
 
 def uncompress(buf: bytes) -> bytes:
+    # fast path: the C++ decoder in the native data plane, when built
+    try:
+        from .native import snappy_uncompress
+        out = snappy_uncompress(buf)
+        if out is not None:
+            return out
+    except ValueError as e:
+        raise SnappyError(str(e)) from e
+    except Exception:
+        pass  # native layer unavailable/broken: pure-Python path below
+    return _uncompress_py(buf)
+
+
+def _uncompress_py(buf: bytes) -> bytes:
     expected, pos = _read_varint32(buf, 0)
     out = bytearray()
     n = len(buf)

@@ -71,9 +71,23 @@ def cmd_train(args) -> int:
                      node_id=args.node_id if args.node_id >= 0 else None)
     eng = _engine_from_args(args)
     eng.profile_steps = args.profile
-    if args.snapshot:
-        eng.restore_from(args.snapshot)
+    snapshot = args.snapshot
+    if snapshot == "auto":
+        # resume from the newest solverstate under the solver's snapshot
+        # prefix — restart-after-preemption without tracking filenames
+        import os
+        from .checkpoint import latest_snapshot
+        prefix = os.path.join(args.output_dir, eng.sp.snapshot_prefix)
+        snapshot = (latest_snapshot(prefix)
+                    if eng.sp.snapshot_prefix else None) or ""
+        if not snapshot:
+            from .metrics import log
+            log(f"--snapshot auto: no snapshot under {prefix!r}; "
+                f"starting fresh", rank=eng.rank)
+    if snapshot:
+        eng.restore_from(snapshot)
     elif args.weights:
+        # first run of an auto-resume launch still honors init weights
         eng.restore_from(args.weights)
     try:
         eng.train()
@@ -347,7 +361,8 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="train a model from a solver prototxt")
     t.add_argument("--solver", required=True)
     t.add_argument("--snapshot", default="",
-                   help="resume from a .solverstate.npz")
+                   help="resume from a .solverstate.npz, or 'auto' to pick "
+                        "the newest one under the solver's snapshot_prefix")
     t.add_argument("--weights", default="",
                    help="finetune from a .caffemodel")
     t.add_argument("--output_dir", default=".")
