@@ -262,6 +262,46 @@ def main() -> None:
             extras["nhwc_speedup"] = round(step_s / nhwc_s, 4)
             del ts3, p3, s3, b3
 
+        # ---- Transformer LM (long-context flagship; beyond-reference) -----
+        if os.environ.get("POSEIDON_BENCH_LM",
+                          "0" if cpu_ok else "1") == "1":
+            from poseidon_tpu.models.transformer import (
+                TransformerConfig, build_dp_sp_train_step, init_params)
+            from poseidon_tpu.parallel import make_mesh
+            from poseidon_tpu.solvers.updates import init_state
+            from poseidon_tpu.proto.messages import SolverParameter as SP
+
+            lm_seq = int(os.environ.get("POSEIDON_BENCH_LM_SEQ", "2048"))
+            lm_batch = int(os.environ.get("POSEIDON_BENCH_LM_BATCH", "8"))
+            lm_cfg = TransformerConfig(
+                vocab_size=32000, d_model=512, n_heads=8, n_layers=8,
+                d_ff=2048, max_seq=lm_seq, remat=True)
+            lm_mesh = make_mesh(axes=("data", "seq"), shape=(n_dev, 1))
+            lm_step = build_dp_sp_train_step(
+                lm_cfg, SP(base_lr=0.01, lr_policy="fixed", momentum=0.9),
+                lm_mesh, donate=False)
+            lp = init_params(lm_cfg, jax.random.PRNGKey(0))
+            ls = init_state(lp)
+            rs2 = np.random.RandomState(1)
+            toks = jnp.asarray(rs2.randint(
+                0, 32000, size=(lm_batch * n_dev, lm_seq), dtype=np.int32))
+            tgts = jnp.asarray(rs2.randint(
+                0, 32000, size=(lm_batch * n_dev, lm_seq), dtype=np.int32))
+            lp, ls, lm_m = lm_step(lp, ls, toks, tgts, jax.random.PRNGKey(1))
+            jax.block_until_ready(lm_m["loss"])
+            t0 = time.perf_counter()
+            lm_iters = max(3, iters // 4)
+            for _ in range(lm_iters):
+                lp, ls, lm_m = lm_step(lp, ls, toks, tgts,
+                                       jax.random.PRNGKey(2))
+            jax.block_until_ready(lm_m["loss"])
+            lm_dt = (time.perf_counter() - t0) / lm_iters
+            extras["lm_tokens_per_sec_per_chip"] = round(
+                lm_batch * lm_seq / lm_dt, 1)
+            extras["lm_seq"] = lm_seq
+            extras["lm_loss"] = float(lm_m["loss"])
+            del lp, ls
+
         # ---- GoogLeNet ----------------------------------------------------
         if with_googlenet:
             g_batch = int(os.environ.get("POSEIDON_BENCH_GOOGLENET_BATCH",

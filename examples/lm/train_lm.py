@@ -1,0 +1,121 @@
+"""Long-context character LM on a 2-D (data x seq) mesh — runnable demo.
+
+The transformer family is this framework's beyond-the-reference flagship:
+batch shards over the "data" axis, the sequence over the "seq" axis (ring
+attention rotates K/V chunks over ICI; on TPU each chunk runs through the
+Pallas flash kernels), with Caffe-exact SGD doing the updates.
+
+    # 8 virtual devices, 2 data x 4 sequence shards:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/lm/train_lm.py --steps 200 --seq 256
+
+    # one real TPU chip (mesh collapses to 1x1):
+    python examples/lm/train_lm.py --steps 500 --seq 2048 --bf16 --remat
+
+Data: the script's own source file, byte-level — no downloads. Loss should
+fall from ~5.5 (ln 256) toward ~2 as it memorizes the file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=8, help="global batch")
+    ap.add_argument("--d_model", type=int, default=128)
+    ap.add_argument("--n_layers", type=int, default=2)
+    ap.add_argument("--n_heads", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--data_axis", type=int, default=0,
+                    help="data-axis size; 0 = auto (devices/seq_axis)")
+    ap.add_argument("--seq_axis", type=int, default=0,
+                    help="seq-axis size; 0 = auto (up to 4)")
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--display", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from poseidon_tpu import config
+    from poseidon_tpu.models.transformer import (
+        TransformerConfig, build_dp_sp_train_step, init_params)
+    from poseidon_tpu.parallel.mesh import make_mesh
+    from poseidon_tpu.proto.messages import SolverParameter
+    from poseidon_tpu.solvers.updates import init_state
+
+    if args.bf16:
+        config.set_policy(compute_dtype=jnp.bfloat16)
+
+    n_dev = jax.device_count()
+    if args.seq_axis:
+        seq_ax = args.seq_axis
+    else:  # largest divisor of the device count, at most 4
+        seq_ax = next(d for d in (4, 3, 2, 1) if n_dev % d == 0)
+    data_ax = args.data_axis or max(1, n_dev // seq_ax)
+    if data_ax * seq_ax != n_dev:
+        raise SystemExit(f"mesh {data_ax}x{seq_ax} != {n_dev} devices "
+                         f"(pick --data_axis/--seq_axis that multiply to "
+                         f"{n_dev})")
+    if args.batch % data_ax or args.seq % seq_ax:
+        raise SystemExit(
+            f"--batch {args.batch} must divide by data axis {data_ax} and "
+            f"--seq {args.seq} by seq axis {seq_ax}")
+    mesh = make_mesh(axes=("data", "seq"), shape=(data_ax, seq_ax))
+    print(f"mesh: data={data_ax} x seq={seq_ax} ({n_dev} devices)")
+
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=4 * args.d_model,
+        max_seq=args.seq, remat=args.remat)
+    sp = SolverParameter(base_lr=args.lr, lr_policy="fixed", momentum=0.9)
+    step = build_dp_sp_train_step(cfg, sp, mesh, donate=False)
+
+    # byte-level corpus: this very file, tiled so any --seq fits
+    corpus = np.frombuffer(open(__file__, "rb").read(), np.uint8)
+    if len(corpus) <= args.seq + 1:
+        corpus = np.tile(corpus, args.seq // len(corpus) + 2)
+    rs = np.random.RandomState(0)
+
+    def sample_batch():
+        starts = rs.randint(0, len(corpus) - args.seq - 1, size=args.batch)
+        toks = np.stack([corpus[s:s + args.seq + 1] for s in starts])
+        return (jnp.asarray(toks[:, :-1].astype(np.int32)),
+                jnp.asarray(toks[:, 1:].astype(np.int32)))
+
+    params, state = init_params(cfg, jax.random.PRNGKey(0)), None
+    state = init_state(params)
+    t0 = steps_timed = 0
+    for it in range(1, args.steps + 1):
+        tokens, targets = sample_batch()
+        params, state, metrics = step(params, state, tokens, targets,
+                                      jax.random.PRNGKey(it))
+        if it == 1:
+            # first step is compile-dominated: report it, then restart the
+            # throughput clock so tok/s reflects steady state
+            print(f"step {it:5d}  loss {float(metrics['loss']):.4f}  "
+                  f"(compiling)", flush=True)
+            t0, steps_timed = time.perf_counter(), 0
+            continue
+        steps_timed += 1
+        if it % args.display == 0:
+            dt = time.perf_counter() - t0
+            tps = steps_timed * args.batch * args.seq / dt
+            print(f"step {it:5d}  loss {float(metrics['loss']):.4f}  "
+                  f"{tps:,.0f} tok/s", flush=True)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

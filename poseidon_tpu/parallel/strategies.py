@@ -73,6 +73,17 @@ class CommConfig:
     # retuned lr per cluster size; select it only for strict reference parity.
     reduce: str = "mean"
     topk_fraction: float = 0.01
+    # Which entries the TOPK budget spends on — the server's UpdateSortPolicy
+    # (configs.hpp:27-33, server_table.cpp:263-297):
+    #   "magnitude"   — largest |g+err| first (RelativeMagnitude, default)
+    #   "random"      — uniform random subset each step (Random)
+    #   "fixed_order" — contiguous 1/k slabs in rotation (FixedOrder; every
+    #                   entry is sent exactly once per ceil(1/fraction) steps)
+    # Measured (docs/performance-guide.md): at small fractions magnitude
+    # converges nearly like dense, random lags, fixed_order can destabilize
+    # (long rotation delay + momentum) — the reference's own reason for
+    # defaulting to RelativeMagnitude importance ordering.
+    topk_policy: str = "magnitude"
     # Optional bandwidth budget for the managed-comm (TOPK) tier, in MB per
     # step per device — the SSPAggr "client_bandwidth_mbps" analog
     # (trans_time_estimate.hpp). When set, topk_fraction is derived from the
@@ -165,19 +176,41 @@ def _sfb_matmul(axes: tuple, reduce: str, with_bias: bool):
     return matmul
 
 
-def topk_compress(g: jax.Array, fraction: float, error: jax.Array):
-    """Magnitude top-k sparsification with error feedback.
+def topk_compress(g: jax.Array, fraction: float, error: jax.Array,
+                  policy: str = "magnitude", step=None):
+    """Budgeted sparsification with error feedback.
 
-    Returns (compressed_dense, new_error): ``compressed_dense`` keeps only the
-    k largest-|.| entries of (g + error); the rest accumulates into the error
-    for the next step — the SSPAggr idea of sending the most important bytes
-    under a budget, with nothing lost, only delayed.
-    """
+    Returns (compressed_dense, new_error): ``compressed_dense`` keeps only a
+    ``fraction`` of the entries of (g + error); the rest accumulates into the
+    error for the next step — the SSPAggr idea of sending the most important
+    bytes under a budget, with nothing lost, only delayed. ``policy`` selects
+    WHICH entries (the server's UpdateSortPolicy): magnitude (default),
+    random, or fixed_order rotation (needs ``step``)."""
     flat = (g + error).reshape(-1)
     k = max(1, int(flat.size * fraction))
-    _, idx = lax.top_k(jnp.abs(flat), k)
-    vals = flat[idx]
-    sent = jnp.zeros_like(flat).at[idx].set(vals)
+    if policy == "magnitude":
+        _, idx = lax.top_k(jnp.abs(flat), k)
+        vals = flat[idx]
+        sent = jnp.zeros_like(flat).at[idx].set(vals)
+    elif policy == "random":
+        if step is None:
+            # a fixed subset every call would strand the complement in the
+            # error buffer forever — same contract as fixed_order
+            raise ValueError("random policy needs the step counter")
+        key = jax.random.fold_in(jax.random.PRNGKey(17), step)
+        scores = jax.random.uniform(key, flat.shape)
+        _, idx = lax.top_k(scores, k)
+        sent = jnp.zeros_like(flat).at[idx].set(flat[idx])
+    elif policy == "fixed_order":
+        if step is None:
+            raise ValueError("fixed_order policy needs the step counter")
+        n_slabs = -(-flat.size // k)  # ceil: full coverage per n_slabs steps
+        start = (step % n_slabs) * k
+        pos = jnp.arange(flat.size)
+        mask = (pos >= start) & (pos < start + k)
+        sent = jnp.where(mask, flat, 0.0)
+    else:
+        raise ValueError(f"unknown topk_policy {policy!r}")
     new_error = (flat - sent).reshape(g.shape)
     return sent.reshape(g.shape), new_error
 

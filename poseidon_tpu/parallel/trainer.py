@@ -186,7 +186,8 @@ def build_train_step(
                     # fast tier: dense sum inside the slice (cheap ICI);
                     # slow tier: compressed exchange between slices
                     g = lax.psum(g, axis)
-                sent, resid = topk_compress(g, topk_fraction, err)
+                sent, resid = topk_compress(g, topk_fraction, err,
+                                            comm.topk_policy, state.solver.it)
                 g_sync = lax.psum(sent, dcn if dcn else axis)
                 if comm.reduce == "mean":
                     g_sync = g_sync / n_total
@@ -349,8 +350,12 @@ def build_ssp_train_step(
                     av = anchor[lname][pname]
                     delta = lv - av
                     if is_topk:
+                        # rotation advances once per SYNC, not per local
+                        # step — with ssp.it a gcd(period, n_slabs) > 1
+                        # would skip slabs forever
                         sent, resid = topk_compress(
-                            delta, topk_fraction, err[lname][pname])
+                            delta, topk_fraction, err[lname][pname],
+                            comm.topk_policy, new_solver.it // period)
                         lerr[pname] = resid
                         delta = sent
                     m = av + scale * lax.psum(delta, axis)

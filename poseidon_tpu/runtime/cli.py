@@ -37,11 +37,14 @@ def _engine_from_args(args, phase_nets=True):
     from ..proto.messages import load_solver
     from .engine import Engine
 
+    import dataclasses
     sp = load_solver(args.solver)
     comm = CommConfig(default_strategy=args.strategy,
-                      reduce=args.grad_reduce)
+                      reduce=args.grad_reduce,
+                      topk_policy=getattr(args, "topk_policy", "magnitude"))
     if args.sfb_auto:
-        comm = CommConfig(reduce=args.grad_reduce)
+        # same config, default strategy reset (auto_strategies fills in SFB)
+        comm = dataclasses.replace(comm, default_strategy="dense")
     mesh = None
     dcn_slices = getattr(args, "dcn_slices", 0)
     if dcn_slices > 1:
@@ -224,7 +227,7 @@ def cmd_time(args) -> int:
     # useful signal.
     if args.per_layer:
         from ..core.layers import ApplyCtx
-        print(f"{'layer':<24}{'type':<22}{'fwd ms':>10}")
+        print(f"{'layer':<24}{'type':<22}{'fwd ms':>10}{'bwd ms':>10}")
         for layer in net.layers:
             bottoms = [jnp.zeros(net.blob_shapes[bname], jnp.float32)
                        for bname in layer.lp.bottom]
@@ -235,19 +238,40 @@ def cmd_time(args) -> int:
                 ctx = ApplyCtx(train=True, rng=jax.random.PRNGKey(0))
                 return _l.apply(ps, bs, ctx)
 
-            try:
-                jitted = jax.jit(run)
-                jax.block_until_ready(jitted(lp_params, bottoms))
+            def timed(fn, *fargs):
+                jitted = jax.jit(fn)
+                jax.block_until_ready(jitted(*fargs))
                 t0 = _time.perf_counter()
                 for _ in range(args.iterations):
-                    out = jitted(lp_params, bottoms)
-                jax.block_until_ready(jax.tree_util.tree_leaves(out)[0]
-                                      if jax.tree_util.tree_leaves(out)
-                                      else jnp.zeros(()))
-                ms = (_time.perf_counter() - t0) / args.iterations * 1e3
-                print(f"{layer.name:<24}{layer.TYPE:<22}{ms:>10.3f}")
+                    out = jitted(*fargs)
+                leaves = jax.tree_util.tree_leaves(out)
+                jax.block_until_ready(leaves[0] if leaves else jnp.zeros(()))
+                return (_time.perf_counter() - t0) / args.iterations * 1e3
+
+            try:
+                fwd_l = timed(run, lp_params, bottoms)
             except Exception as e:  # e.g. int-labeled losses fed zeros
-                print(f"{layer.name:<24}{layer.TYPE:<22}{'skip':>10} ({e})")
+                print(f"{layer.name:<24}{layer.TYPE:<22}{'skip':>10}"
+                      f"{'skip':>10} ({e})")
+                continue
+            # per-layer backward: grad wrt params+bottoms of a scalarized
+            # output (the reference's Backward timing, caffe_main.cpp:300+).
+            # jax.grad re-runs the forward inside, so subtract fwd time to
+            # report the backward alone like the reference does.
+            try:
+                def bwd(ps, bs, _l=layer):
+                    out = run(ps, bs, _l=_l)
+                    return sum(jnp.sum(o.astype(jnp.float32))
+                               for o in jax.tree_util.tree_leaves(out))
+
+                fb_l = timed(jax.grad(bwd, argnums=(0, 1)),
+                             lp_params, bottoms)
+                bwd_l = max(fb_l - fwd_l, 0.0)
+                print(f"{layer.name:<24}{layer.TYPE:<22}{fwd_l:>10.3f}"
+                      f"{bwd_l:>10.3f}")
+            except Exception:  # non-differentiable layer (data/accuracy/...)
+                print(f"{layer.name:<24}{layer.TYPE:<22}{fwd_l:>10.3f}"
+                      f"{'-':>10}")
 
     # Static per-layer comm accounting over a hypothetical mesh — what each
     # strategy moves per step and what it saves vs dense (stats.hpp analog).
@@ -372,6 +396,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sfb-auto", action="store_true",
                    help="pick SFB per FC layer by cost model (SACP)")
     t.add_argument("--grad-reduce", default="mean", choices=["mean", "sum"])
+    t.add_argument("--topk_policy", default="magnitude",
+                   choices=["magnitude", "random", "fixed_order"],
+                   help="which entries the TOPK budget sends (the server's "
+                        "UpdateSortPolicy)")
     t.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (MXU-native); params/updates stay "
                         "f32. Default f32 matches Caffe numerics exactly")
