@@ -27,49 +27,71 @@ def _free_port():
     return port
 
 
-@pytest.mark.skipif(not os.path.isdir(
-    os.path.join(REPO, "examples/mnist/mnist_train_lmdb")),
-    reason="synthetic MNIST LMDB not generated")
-def test_two_process_training(tmp_path):
+def _run_local_train(tmp_path, prefix: str, max_iter: int, extra_args=()):
+    """Drive the REAL launcher (scripts/launch.py --local path): 2 processes
+    x 4 virtual devices training lenet; returns (logs, per-process snapshot
+    npz handles at max_iter)."""
     solver = tmp_path / "solver.prototxt"
     solver.write_text(f"""
 net: "{REPO}/examples/mnist/lenet_train_test.prototxt"
 base_lr: 0.01
 lr_policy: "fixed"
 momentum: 0.9
-display: 10
-max_iter: 12
+display: 5
+max_iter: {max_iter}
 test_interval: 0
 snapshot_after_train: true
-snapshot_prefix: "lenet_mp"
+snapshot_prefix: "{prefix}"
 random_seed: 5
 """)
     outs = [tmp_path / "p0", tmp_path / "p1"]
     for o in outs:
         o.mkdir()
-    # Drive the REAL launcher (scripts/launch.py --local path) rather than
-    # re-implementing its env plumbing here.
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    scripts = os.path.join(REPO, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
     import launch
     rc, raw_logs = launch.launch_local(
         2, 4, _free_port(),
-        ["train", "--solver", str(solver),
+        ["train", "--solver", str(solver), *extra_args,
          "--output_dir", str(tmp_path / "p{proc_id}")],
         capture=True)
     logs = [b.decode() for b in raw_logs]
     assert rc == 0, f"launch failed:\n{logs[0][-2000:]}\n{logs[1][-2000:]}"
-
-    # both processes wrote a snapshot at iter 12; params must be identical
-    # (replicated state across the 8-device global mesh)
-    snaps = [np.load(str(o / "lenet_mp_iter_12.solverstate.npz"))
+    snaps = [np.load(str(o / f"{prefix}_iter_{max_iter}.solverstate.npz"))
              for o in outs]
-    keys = set(snaps[0].files)
-    assert keys == set(snaps[1].files)
-    for k in keys:
-        np.testing.assert_array_equal(snaps[0][k], snaps[1][k])
+    # replicated state: every process writes identical snapshot bytes
+    assert set(snaps[0].files) == set(snaps[1].files)
+    for k in snaps[0].files:
+        np.testing.assert_array_equal(snaps[0][k], snaps[1][k], err_msg=k)
+    return logs, snaps
 
+
+@pytest.mark.skipif(not os.path.isdir(
+    os.path.join(REPO, "examples/mnist/mnist_train_lmdb")),
+    reason="synthetic MNIST LMDB not generated")
+def test_two_process_training(tmp_path):
+    logs, _ = _run_local_train(tmp_path, "lenet_mp", 12)
     # training actually progressed (loss decreased in the rank-0 log)
     assert "Iteration 10" in logs[0]
+
+
+@pytest.mark.skipif(not os.path.isdir(
+    os.path.join(REPO, "examples/mnist/mnist_train_lmdb")),
+    reason="synthetic MNIST LMDB not generated")
+def test_two_process_two_tier_training(tmp_path):
+    """--dcn_slices 2 across TWO REAL PROCESSES: the dcn axis lands on the
+    inter-process boundary (each process's 4 local devices form one slice) —
+    exactly the topology the managed-comm tier exists for."""
+    _, snaps = _run_local_train(
+        tmp_path, "lenet_tier", 10,
+        ["--dcn_slices", "2", "--strategy", "topk"])
+    # PER-SLICE residuals (leading dim = 2 slices, not 8 devices): pins the
+    # hierarchical grouping, not just that TOPK ran
+    err_keys = [k for k in snaps[0].files if k.startswith("comm_error/")]
+    assert err_keys
+    for k in err_keys:
+        assert snaps[0][k].shape[0] == 2, (k, snaps[0][k].shape)
 
 
 @pytest.mark.skipif(not os.path.isdir(
