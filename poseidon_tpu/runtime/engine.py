@@ -90,6 +90,12 @@ class Engine:
         self.rank = jax.process_index()
         self.memory_data = memory_data
 
+        if sp.iter_size > 1:
+            # parsed for V2-prototxt compat; the 2015 reference predates it
+            log("WARNING: iter_size > 1 (gradient accumulation) is parsed "
+                "but not applied; increase batch_size instead (per-device "
+                "semantics, docs/distributed-guide.md)", rank=self.rank)
+
         train_param, test_params = resolve_nets(sp)
 
         # --- data pipelines for the train net ---------------------------- #
@@ -178,6 +184,35 @@ class Engine:
                                                    keep_blobs=True).blobs)
              if any(outs) else None)
             for net, outs in zip(self.test_nets, self._h5_outputs)]
+
+        # debug_info (solver.cpp:326,422; net.cpp ForwardDebugInfo/
+        # UpdateDebugInfo): per-layer mean-|.| of activations, params, and
+        # gradients, printed at display boundaries. Off the hot path — a
+        # separate jitted pass that runs only when enabled.
+        self._debug_fn = None
+        if sp.debug_info and not sp.display:
+            log("WARNING: debug_info needs a display cadence (display: N) "
+                "to print; set display in the solver", rank=self.rank)
+        elif sp.debug_info:
+            def _debug(params, batch, rng):
+                out = self.train_net.apply(
+                    params, batch, train=True, rng=rng, keep_blobs=True)
+                grads = jax.grad(
+                    lambda p: self.train_net.apply(
+                        p, batch, train=True, rng=rng).loss)(params)
+                stats = {}
+                for name, v in out.blobs.items():
+                    stats[f"blob\x00{name}"] = jnp.mean(jnp.abs(
+                        v.astype(jnp.float32)))
+                for lname, lp in params.items():
+                    for pname, w in lp.items():
+                        stats[f"param\x00{lname}/{pname}"] = jnp.mean(
+                            jnp.abs(w.astype(jnp.float32)))
+                        stats[f"grad\x00{lname}/{pname}"] = jnp.mean(
+                            jnp.abs(grads[lname][pname].astype(jnp.float32)))
+                return stats
+
+            self._debug_fn = jax.jit(_debug)
 
     # ---------------------------------------------------------------- #
     def _build_pipelines(self, net_param: NetParameter, phase: str):
@@ -296,6 +331,17 @@ class Engine:
                     os.path.join(self.output_dir, "profile"))
                 profiling = True
             batch = self._next_batch(self.train_pipelines)
+            at_display = bool(sp.display) and (it + 1) % sp.display == 0
+            if at_display and self._debug_fn:
+                # BEFORE the step, on the step's own inputs (pre-update
+                # params, this iteration's rng/batch) — the values Caffe's
+                # ForwardDebugInfo/UpdateDebugInfo report for iteration it+1
+                stats = self._debug_fn(self.params, batch,
+                                       jax.random.fold_in(self.rng, it))
+                for key in sorted(stats):
+                    kind, name = key.split("\x00")
+                    log(f"    [debug] {kind:<5} {name}: "
+                        f"{float(stats[key]):.6g}", rank=self.rank)
             t0 = time.time()
             result = self.train_step.step(
                 self.params, self.state, batch, jax.random.fold_in(self.rng, it))
@@ -327,7 +373,7 @@ class Engine:
                         {k: float(v) for k, v in pm.items()})
                 last = {k: float(v) for k, v in pending[-1].items()}
                 pending = []
-            if sp.display and it % sp.display == 0:
+            if at_display:  # same boundary: it has incremented since
                 for pm in pending:
                     self.metrics.accumulate(
                         {k: float(v) for k, v in pm.items()})
