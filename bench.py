@@ -157,12 +157,15 @@ def _step_flops(ts, params, state, batch) -> float:
     import jax
     try:
         rng = jax.random.PRNGKey(1)
-        compiled = ts.step.lower(params, state, batch, rng).compile()
+        lowerable = ts.lowerable or ts.step
+        compiled = lowerable.lower(params, state, batch, rng).compile()
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         return float(ca.get("flops", 0.0))
-    except Exception:
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] cost analysis unavailable: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
         return 0.0
 
 
@@ -273,6 +276,37 @@ def main() -> None:
             extras["nhwc_step_ms"] = round(nhwc_s * 1e3, 3)
             extras["nhwc_speedup"] = round(step_s / nhwc_s, 4)
             del ts3, p3, s3, b3
+
+        # ---- TOPK selection cost at fc6 scale: global vs blocked ----------
+        if os.environ.get("POSEIDON_BENCH_TOPK",
+                          "0" if cpu_ok else "1") == "1" and \
+                budget_left("topk_cost"):
+            from poseidon_tpu.parallel.strategies import topk_compress
+            fc6_n = int(os.environ.get("POSEIDON_BENCH_TOPK_N",
+                                       str(4096 * 9216)))  # fc6 = 37.7M
+            frac = 0.01
+            g = jnp.asarray(np.random.RandomState(3)
+                            .randn(fc6_n).astype(np.float32))
+            err0 = jnp.zeros_like(g)
+
+            def _time_compress(fn):
+                s, e = fn(g, err0)
+                jax.block_until_ready(s)
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    s, e = fn(g, e)
+                jax.block_until_ready(s)
+                return (time.perf_counter() - t0) / 5 * 1e3
+
+            glob = jax.jit(lambda gg, ee: topk_compress(gg, frac, ee))
+            blk = jax.jit(lambda gg, ee: topk_compress(gg, frac, ee,
+                                                       block=4096))
+            extras["topk_global_ms"] = round(_time_compress(glob), 3)
+            extras["topk_blocked_ms"] = round(_time_compress(blk), 3)
+            extras["topk_blocked_speedup"] = round(
+                extras["topk_global_ms"] /
+                max(extras["topk_blocked_ms"], 1e-9), 2)
+            del g, err0
 
         # ---- Transformer LM (long-context flagship; beyond-reference) -----
         if os.environ.get("POSEIDON_BENCH_LM",

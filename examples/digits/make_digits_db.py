@@ -1,0 +1,64 @@
+"""Build Caffe-layout LMDBs from sklearn's bundled handwritten-digits set.
+
+REAL data in a zero-egress environment: the repo's CIFAR/MNIST LMDBs are
+synthetic test fixtures, and the CIFAR-10 download
+(examples/cifar10/fetch_real_cifar10.py) needs network access this machine
+does not have. scikit-learn ships the UCI ML handwritten digits test set
+in-package (sklearn.datasets.load_digits: 1,797 real 8x8 grayscale digits,
+a genuine published dataset) — the only real image data available here, so
+it anchors the accuracy-parity story (examples/digits/stat.md) the way
+examples/cifar10/stat.md anchors the reference's.
+
+Deterministic split: last 360 samples (20%) are the test set, matching the
+dataset's documented train/test convention of contiguous blocks per writer.
+Pixel range 0..16 is scaled to 0..255 so transform_param scaling behaves
+like every other Datum-backed source (convert_cifar_data.cpp layout).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", ".."))
+
+from poseidon_tpu.data.lmdb_reader import LMDBWriter            # noqa: E402
+from poseidon_tpu.proto.wire import Datum, encode_datum        # noqa: E402
+from poseidon_tpu.runtime.tools import compute_image_mean      # noqa: E402
+
+N_TEST = 360  # 20%
+
+
+def _write(images: np.ndarray, labels: np.ndarray, out_path: str) -> int:
+    w = LMDBWriter(out_path)
+    for i, (img, label) in enumerate(zip(images, labels)):
+        pix = np.round(img * (255.0 / 16.0)).astype(np.uint8)  # 0..16 -> 0..255
+        d = Datum(channels=1, height=8, width=8,
+                  data=pix.tobytes(), label=int(label))
+        w.put(f"{i:05d}".encode(), encode_datum(d))
+    w.close()
+    print(f"{out_path}: {len(labels)} records")
+    return len(labels)
+
+
+def main() -> None:
+    from sklearn.datasets import load_digits
+    ds = load_digits()
+    images, labels = ds.images, ds.target  # (1797, 8, 8) float 0..16
+    train_db = os.path.join(HERE, "digits_train_lmdb")
+    test_db = os.path.join(HERE, "digits_test_lmdb")
+    for p in (train_db, test_db):
+        if os.path.exists(p):
+            raise SystemExit(f"{p} already exists")
+    assert _write(images[:-N_TEST], labels[:-N_TEST], train_db) == 1437
+    assert _write(images[-N_TEST:], labels[-N_TEST:], test_db) == N_TEST
+    compute_image_mean(train_db, os.path.join(HERE, "mean.binaryproto"))
+    print("done — train with:\n  python -m poseidon_tpu train "
+          "--solver=examples/digits/digits_solver.prototxt")
+
+
+if __name__ == "__main__":
+    main()

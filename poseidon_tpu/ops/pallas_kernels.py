@@ -4,9 +4,9 @@
 materializes the (S, S) score matrix in HBM. Grid is (batch*heads,
 query-blocks); each program streams key/value blocks through the
 online-softmax recurrence (the same math as ops/attention.py's BlockAcc, here
-per 128-row tile). The backward pass currently recomputes through the
-reference attention's VJP (correct, O(S^2) memory in HBM); a Pallas backward
-is future work.
+per 128-row tile). The backward pass is likewise Pallas and O(S) in HBM: the
+dq and dk/dv kernels below recompute scores blockwise from the saved
+(out, logsumexp) residuals, wired up via ``defvjp``.
 
 ``lrn_fused``: cross-channel LRN forward in one VMEM pass — x^2, the
 channel-window running sum, pow, and the product fused per (H*W)-tile, saving

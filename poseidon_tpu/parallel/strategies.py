@@ -53,6 +53,8 @@ TOPK = "topk"        # magnitude top-k compressed psum with error feedback
 # measurement of the overlap win; not a production choice.
 DENSE_FUSED = "dense_fused"
 
+WIRE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "f16": jnp.float16}
+
 
 @dataclass
 class CommConfig:
@@ -89,9 +91,36 @@ class CommConfig:
     # (trans_time_estimate.hpp). When set, topk_fraction is derived from the
     # budget over the TOPK layers' total parameter count.
     bandwidth_budget_mb: Optional[float] = None
+    # Reduced-precision gradient exchange — the DenseRowFloat16 analog
+    # (ps/src/petuum_ps_common/storage/dense_row_float16.hpp:10-16: the
+    # reference could hold parameter rows in float16 to halve comm+storage).
+    # One of None (exchange at gradient dtype), "bf16", "f16", "f32".
+    # Gradients are cast to the wire dtype before every collective (psum /
+    # all-gather) and the result is cast back up, with the mean division in
+    # f32. The quantization error folds into the TOPK error-feedback residual
+    # where one exists (nothing lost, only delayed — better than the
+    # reference, which simply stored f16).
+    wire_dtype: Optional[str] = None
+    # Blocked top-k selection: when set, magnitude/random TOPK picks the
+    # top-k within fixed-size blocks of this many elements instead of one
+    # global sort — the row-granular spirit of the reference's server, which
+    # ranks cheap per-row importance scores rather than every element
+    # (server_table.cpp:263-297). A batched top-k over (n_blocks, block) is
+    # far cheaper on TPU than lax.top_k over tens of millions of elements.
+    topk_block: Optional[int] = None
 
     def strategy_for(self, layer: str) -> str:
         return self.layer_strategies.get(layer, self.default_strategy)
+
+    def wire_jnp_dtype(self):
+        if self.wire_dtype is None:
+            return None
+        try:
+            return WIRE_DTYPES[self.wire_dtype]
+        except KeyError:
+            raise ValueError(
+                f"unknown wire_dtype {self.wire_dtype!r}; "
+                f"choose from {sorted(WIRE_DTYPES)}") from None
 
     @property
     def sync_axes(self) -> tuple:
@@ -109,8 +138,20 @@ def _maybe_mean(g, axes: tuple, reduce: str):
     return g
 
 
+def wire_psum(g, axes: tuple, reduce: str, wire: Optional[str]):
+    """psum with an optional reduced-precision wire: cast the operand to the
+    wire dtype so the collective itself moves (and reduces in) half-width
+    values — the DenseRowFloat16 trade — then do the mean scaling in f32 and
+    cast back to the gradient dtype."""
+    wd = WIRE_DTYPES.get(wire) if wire else None
+    if wd is None or g.dtype == wd:
+        return _maybe_mean(lax.psum(g, axes), axes, reduce)
+    s = lax.psum(g.astype(wd), axes).astype(jnp.float32)
+    return _maybe_mean(s, axes, reduce).astype(g.dtype)
+
+
 @functools.lru_cache(maxsize=None)
-def _sync_tap(axes: tuple, reduce: str):
+def _sync_tap(axes: tuple, reduce: str, wire: Optional[str] = None):
     @jax.custom_vjp
     def tap(w):
         return w
@@ -119,14 +160,15 @@ def _sync_tap(axes: tuple, reduce: str):
         return w, None
 
     def bwd(_, g):
-        return (_maybe_mean(lax.psum(g, axes), axes, reduce),)
+        return (wire_psum(g, axes, reduce, wire),)
 
     tap.defvjp(fwd, bwd)
     return tap
 
 
 @functools.lru_cache(maxsize=None)
-def _sfb_matmul(axes: tuple, reduce: str, with_bias: bool):
+def _sfb_matmul(axes: tuple, reduce: str, with_bias: bool,
+                wire: Optional[str] = None):
     """FC forward on the local shard; backward reconstructs global ∇W from
     all-gathered sufficient factors."""
 
@@ -158,9 +200,14 @@ def _sfb_matmul(axes: tuple, reduce: str, with_bias: bool):
             (((1,), (0,)), ((), ())),
             preferred_element_type=p.accum_dtype,
             precision=matmul_precision()).astype(x2.dtype)
-        # sufficient factors: a = top diff (B, M), b = bottom data (B, K)
-        G = lax.all_gather(g, axes, tiled=True)       # (B_global, M)
-        X = lax.all_gather(x2, axes, tiled=True)      # (B_global, K)
+        # sufficient factors: a = top diff (B, M), b = bottom data (B, K);
+        # with a wire dtype set the factors cross the interconnect at
+        # reduced precision, the local outer product still accumulates f32
+        wd = WIRE_DTYPES.get(wire) if wire else None
+        g_w = g.astype(wd) if wd is not None and g.dtype != wd else g
+        x_w = x2.astype(wd) if wd is not None and x2.dtype != wd else x2
+        G = lax.all_gather(g_w, axes, tiled=True)     # (B_global, M)
+        X = lax.all_gather(x_w, axes, tiled=True)     # (B_global, K)
         gw = lax.dot_general(
             G.astype(p.compute_dtype), X.astype(p.compute_dtype),
             (((0,), (0,)), ((), ())),
@@ -168,7 +215,7 @@ def _sfb_matmul(axes: tuple, reduce: str, with_bias: bool):
             precision=matmul_precision())     # (M, K) — global f32 sum
         gw = _maybe_mean(gw, axes, reduce).astype(w.dtype)
         if with_bias:
-            gb = _maybe_mean(lax.psum(jnp.sum(g, axis=0), axes), axes, reduce)
+            gb = wire_psum(jnp.sum(g, axis=0), axes, reduce, wire)
             return gx, gw, gb
         return gx, gw, None
 
@@ -176,8 +223,40 @@ def _sfb_matmul(axes: tuple, reduce: str, with_bias: bool):
     return matmul
 
 
+def comm_salt(layer: str, pname: str) -> int:
+    """Stable per-tensor salt for the random topk policy, so same-shaped
+    tensors across layers don't select correlated index subsets (the
+    reference's Random UpdateSortPolicy draws independently per table)."""
+    import zlib
+    return zlib.crc32(f"{layer}/{pname}".encode())
+
+
+def _blocked_select(flat: jax.Array, scores: jax.Array, k: int,
+                    block: int) -> jax.Array:
+    """Keep the top-scoring entries *per fixed-size block* — the row-granular
+    spirit of the reference server, which ranks cheap per-row importance
+    scores instead of sorting every element (server_table.cpp:263-297). A
+    batched ``lax.top_k`` over (n_blocks, block) rows is far cheaper on TPU
+    than one global top-k over tens of millions of elements."""
+    n = flat.size
+    nb = -(-n // block)
+    kb = max(1, -(-k // nb))  # per-block budget; total >= k
+    pad = nb * block - n
+    # pad with -inf scores so padding never wins a slot
+    fp = jnp.pad(flat, (0, pad)).reshape(nb, block)
+    sp = jnp.pad(scores, (0, pad),
+                 constant_values=-jnp.inf).reshape(nb, block)
+    _, idx = lax.top_k(sp, kb)                       # (nb, kb)
+    rows = jnp.arange(nb)[:, None]
+    sent = jnp.zeros_like(fp).at[rows, idx].set(
+        jnp.take_along_axis(fp, idx, axis=1))
+    return sent.reshape(-1)[:n]
+
+
 def topk_compress(g: jax.Array, fraction: float, error: jax.Array,
-                  policy: str = "magnitude", step=None):
+                  policy: str = "magnitude", step=None, salt: int = 0,
+                  block: Optional[int] = None,
+                  wire: Optional[str] = None):
     """Budgeted sparsification with error feedback.
 
     Returns (compressed_dense, new_error): ``compressed_dense`` keeps only a
@@ -185,22 +264,31 @@ def topk_compress(g: jax.Array, fraction: float, error: jax.Array,
     error for the next step — the SSPAggr idea of sending the most important
     bytes under a budget, with nothing lost, only delayed. ``policy`` selects
     WHICH entries (the server's UpdateSortPolicy): magnitude (default),
-    random, or fixed_order rotation (needs ``step``)."""
+    random, or fixed_order rotation (needs ``step``). ``block`` switches the
+    magnitude/random selection to per-block top-k (see ``_blocked_select``).
+    ``wire`` additionally quantizes the sent values to the wire dtype, with
+    the quantization error folded into the residual (nothing lost)."""
     flat = (g + error).reshape(-1)
     k = max(1, int(flat.size * fraction))
     if policy == "magnitude":
-        _, idx = lax.top_k(jnp.abs(flat), k)
-        vals = flat[idx]
-        sent = jnp.zeros_like(flat).at[idx].set(vals)
+        if block and flat.size > block:
+            sent = _blocked_select(flat, jnp.abs(flat), k, block)
+        else:
+            _, idx = lax.top_k(jnp.abs(flat), k)
+            vals = flat[idx]
+            sent = jnp.zeros_like(flat).at[idx].set(vals)
     elif policy == "random":
         if step is None:
             # a fixed subset every call would strand the complement in the
             # error buffer forever — same contract as fixed_order
             raise ValueError("random policy needs the step counter")
-        key = jax.random.fold_in(jax.random.PRNGKey(17), step)
+        key = jax.random.fold_in(jax.random.PRNGKey(17 + salt), step)
         scores = jax.random.uniform(key, flat.shape)
-        _, idx = lax.top_k(scores, k)
-        sent = jnp.zeros_like(flat).at[idx].set(flat[idx])
+        if block and flat.size > block:
+            sent = _blocked_select(flat, scores, k, block)
+        else:
+            _, idx = lax.top_k(scores, k)
+            sent = jnp.zeros_like(flat).at[idx].set(flat[idx])
     elif policy == "fixed_order":
         if step is None:
             raise ValueError("fixed_order policy needs the step counter")
@@ -211,6 +299,10 @@ def topk_compress(g: jax.Array, fraction: float, error: jax.Array,
         sent = jnp.where(mask, flat, 0.0)
     else:
         raise ValueError(f"unknown topk_policy {policy!r}")
+    wd = WIRE_DTYPES.get(wire) if wire else None
+    if wd is not None and sent.dtype != wd:
+        # quantize to the wire width; the rounding error joins the residual
+        sent = sent.astype(wd).astype(flat.dtype)
     new_error = (flat - sent).reshape(g.shape)
     return sent.reshape(g.shape), new_error
 
@@ -229,16 +321,18 @@ class CommContext:
             # residual in TrainState.comm_error (trainer.py). DENSE_FUSED:
             # the trainer psums after the whole backward (no-overlap A/B).
             return w
-        return _sync_tap(self.cfg.sync_axes, self.cfg.reduce)(w)
+        return _sync_tap(self.cfg.sync_axes, self.cfg.reduce,
+                         self.cfg.wire_dtype)(w)
 
     def inner_product(self, layer: str, x, w, b) -> Optional[jax.Array]:
         if self.cfg.strategy_for(layer) != SFB:
             return None
         axes = self.cfg.sync_axes
+        wire = self.cfg.wire_dtype
         x2 = x.reshape(x.shape[0], -1)
         if b is not None:
-            return _sfb_matmul(axes, self.cfg.reduce, True)(x2, w, b)
-        return _sfb_matmul(axes, self.cfg.reduce, False)(
+            return _sfb_matmul(axes, self.cfg.reduce, True, wire)(x2, w, b)
+        return _sfb_matmul(axes, self.cfg.reduce, False, wire)(
             x2, w, jnp.zeros((w.shape[0],), w.dtype))
 
 

@@ -29,7 +29,8 @@ from ..core.net import Net
 from ..proto.messages import SolverParameter
 from ..solvers.updates import SolverState, init_state, make_update_fn
 from .strategies import (CommConfig, CommContext, DENSE_FUSED, LOCAL, SFB,
-                         TOPK, budget_topk_fraction, topk_compress)
+                         TOPK, budget_topk_fraction, comm_salt, topk_compress,
+                         wire_psum)
 
 
 def param_mults(net: Net) -> Dict[str, Dict[str, tuple]]:
@@ -90,6 +91,9 @@ class TrainStep:
     mesh: Mesh
     batch_sharding: NamedSharding
     replicated: NamedSharding
+    # The underlying jitted callable, for .lower()/.compile() introspection
+    # (cost analysis, AOT). ``step`` may be a plain wrapper hiding those.
+    lowerable: Optional[Callable] = None
 
 
 def comm_error_groups(comm: Optional[CommConfig], mesh: Mesh) -> int:
@@ -125,6 +129,7 @@ def build_train_step(
     the step additionally returns those activation blobs, batch-sharded —
     the fourth element of the step's result tuple."""
     comm = comm or CommConfig()
+    comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
     dcn = comm.dcn_axis
     axes = comm.sync_axes  # (dcn, data) or (data,)
@@ -170,10 +175,8 @@ def build_train_step(
         # no-overlap baseline for the DWBP A/B.
         for lname in fused_layers:
             for pname, g in grads[lname].items():
-                g_sync = lax.psum(g, axes)
-                if comm.reduce == "mean":
-                    g_sync = g_sync / n_total
-                grads[lname][pname] = g_sync
+                grads[lname][pname] = wire_psum(g, axes, comm.reduce,
+                                                comm.wire_dtype)
         # Managed-comm tier: TOPK layers were left un-psummed by the tap;
         # compress the (residual-corrected) gradient, exchange only the
         # top-k entries, keep the remainder as next step's residual.
@@ -183,12 +186,19 @@ def build_train_step(
             for pname, g in grads[lname].items():
                 err = state.comm_error[lname][pname][0]  # unstack group dim
                 if dcn:
-                    # fast tier: dense sum inside the slice (cheap ICI);
+                    # fast tier: dense sum inside the slice (cheap ICI, at
+                    # wire width; the cast error folds into the residual);
                     # slow tier: compressed exchange between slices
-                    g = lax.psum(g, axis)
+                    g = wire_psum(g, (axis,), "sum", comm.wire_dtype)
                 sent, resid = topk_compress(g, topk_fraction, err,
-                                            comm.topk_policy, state.solver.it)
-                g_sync = lax.psum(sent, dcn if dcn else axis)
+                                            comm.topk_policy, state.solver.it,
+                                            salt=comm_salt(lname, pname),
+                                            block=comm.topk_block,
+                                            wire=comm.wire_dtype)
+                # sent is already wire-quantized, so the wire-dtype psum
+                # operand cast is exact
+                g_sync = wire_psum(sent, (dcn,) if dcn else (axis,), "sum",
+                                   comm.wire_dtype)
                 if comm.reduce == "mean":
                     g_sync = g_sync / n_total
                 grads[lname][pname] = g_sync
@@ -221,6 +231,7 @@ def build_train_step(
         mesh=mesh,
         batch_sharding=NamedSharding(mesh, batch_spec),
         replicated=NamedSharding(mesh, P()),
+        lowerable=jitted,
     )
 
 
@@ -286,53 +297,83 @@ def build_ssp_train_step(
               (the SSPAggr pairing of staleness + bandwidth budget);
       LOCAL — never synchronized (the reference's LOCAL blob mode; replicas
               keep divergent copies, legal here unlike in the sync step).
-    SFB is rejected: it is a *backward-time* per-step factor exchange — under
-    SSP there is no per-step exchange to ride on (the reference's SVB likewise
-    drains sufficient vectors every iteration, i.e. it runs each FC layer at
-    effective staleness 0; if you want SFB, use build_train_step).
+
+    **Two-tier composition** (``comm.dcn_axis`` set): staleness moves to the
+    slow (DCN) tier — each *slice* diverges for up to s steps and slices
+    reconcile deltas every s+1 — while inside a slice the fast ICI tier syncs
+    densely every step with in-backward taps. This is exactly the reference
+    SSPAggr deployment (ssp_aggr_bg_worker.cpp:379-474: full-rate updates
+    inside a machine, bounded-staleness bandwidth-managed bytes across).
+    Intra-slice, DENSE/SFB ride the per-step backward-time exchange (so SFB
+    *is* legal here, unlike flat SSP); TOPK/LOCAL/DENSE_FUSED gradients are
+    dense-psummed intra-slice after backward. At the DCN sync boundary,
+    non-LOCAL deltas are exchanged (TOPK-compressed where configured).
+
+    On a flat mesh, SFB is rejected: it is a *backward-time* per-step factor
+    exchange — under flat SSP there is no per-step exchange to ride on (the
+    reference's SVB likewise drains sufficient vectors every iteration, i.e.
+    it runs each FC layer at effective staleness 0).
     """
+    import dataclasses
     comm = comm or CommConfig()
-    if comm.dcn_axis is not None:
-        raise ValueError(
-            "SSP staleness over a two-tier (dcn) mesh is not supported: "
-            "bounded staleness and hierarchical TOPK both manage the slow "
-            "tier — compose staleness with flat TOPK, or use the two-tier "
-            "sync step (build_train_step with comm.dcn_axis)")
+    comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
+    dcn = comm.dcn_axis
     update_fn = make_update_fn(sp, param_mults(net))
     period = staleness + 1
-    n_dev = mesh.shape[axis]
+    # the tier that carries staleness: slices on a two-tier mesh, devices on
+    # a flat one
+    group_axis = dcn if dcn else axis
+    n_groups = mesh.shape[group_axis]
+    n_ici = mesh.shape[axis] if dcn else 1
+    n_total = n_groups * max(1, n_ici)
 
     for lname in net.param_defs:
-        if comm.strategy_for(lname) == SFB:
+        if comm.strategy_for(lname) == SFB and not dcn:
             raise ValueError(
                 f"layer {lname!r}: SFB is a per-step backward-time exchange "
-                f"and cannot compose with SSP local steps; use DENSE or TOPK "
-                f"(delta compression) under staleness > 0")
+                f"and cannot compose with flat-mesh SSP local steps; use "
+                f"DENSE or TOPK (delta compression), or a two-tier mesh "
+                f"(comm.dcn_axis) where SFB rides the intra-slice tier")
 
     topk_layers = [l for l in net.param_defs
                    if comm.strategy_for(l) == TOPK]
     local_layers = {l for l in net.param_defs
                     if comm.strategy_for(l) == LOCAL}
     topk_fraction = budget_topk_fraction(net, comm)
+    # under dcn: strategies whose gradients the in-backward taps leave raw
+    # and therefore need the explicit intra-slice psum after backward
+    raw_ici_layers = [l for l in net.param_defs
+                      if comm.strategy_for(l) in (TOPK, LOCAL, DENSE_FUSED)]
+    ici_ctx = (CommContext(dataclasses.replace(comm, dcn_axis=None))
+               if dcn else None)
 
     def device_step(ssp: SSPState, batch, rng):
-        rng = jax.random.fold_in(rng, lax.axis_index(axis))
+        flat_idx = lax.axis_index(axis)
+        if dcn:
+            flat_idx = flat_idx + mesh.shape[axis] * lax.axis_index(dcn)
+        rng = jax.random.fold_in(rng, flat_idx)
         squeeze = lambda tree: jax.tree_util.tree_map(lambda x: x[0], tree)
         local = squeeze(ssp.local_params)
         history = squeeze(ssp.local_history)
         error = squeeze(ssp.comm_error)
 
         def loss_fn(p):
-            out = net.apply(p, batch, train=True, rng=rng, comm=None)
+            out = net.apply(p, batch, train=True, rng=rng, comm=ici_ctx)
             return out.loss, out
 
         grads, out = jax.grad(loss_fn, has_aux=True)(local)
+        if dcn:
+            # intra-slice dense tier for strategies the taps left raw
+            for lname in raw_ici_layers:
+                for pname, g in grads[lname].items():
+                    grads[lname][pname] = wire_psum(
+                        g, (axis,), comm.reduce, comm.wire_dtype)
         new_local, new_solver = update_fn(
             local, grads, SolverState(it=ssp.it, history=history))
 
         do_sync = (new_solver.it % period) == 0
-        scale = 1.0 / n_dev if comm.reduce == "mean" else 1.0
+        scale = 1.0 / n_groups if comm.reduce == "mean" else 1.0
 
         def sync(args):
             l, anchor, err = args
@@ -355,10 +396,13 @@ def build_ssp_train_step(
                         # would skip slabs forever
                         sent, resid = topk_compress(
                             delta, topk_fraction, err[lname][pname],
-                            comm.topk_policy, new_solver.it // period)
+                            comm.topk_policy, new_solver.it // period,
+                            salt=comm_salt(lname, pname),
+                            block=comm.topk_block, wire=comm.wire_dtype)
                         lerr[pname] = resid
                         delta = sent
-                    m = av + scale * lax.psum(delta, axis)
+                    m = av + scale * wire_psum(delta, (group_axis,), "sum",
+                                               comm.wire_dtype)
                     merged[lname][pname] = m
                     new_anchor[lname][pname] = m
                 if is_topk:
@@ -368,24 +412,30 @@ def build_ssp_train_step(
         new_local, new_anchor, new_error = lax.cond(
             do_sync, sync, lambda args: args,
             (new_local, ssp.anchor_params, error))
-        metrics = {"loss": lax.psum(out.loss, axis) / n_dev}
+        axes_all = (dcn, axis) if dcn else (axis,)
+        metrics = {"loss": lax.psum(out.loss, axes_all) / n_total}
         for name, val in out.outputs.items():
             if val.ndim == 0:
-                metrics[name] = lax.psum(val.astype(jnp.float32), axis) / n_dev
+                metrics[name] = lax.psum(val.astype(jnp.float32),
+                                         axes_all) / n_total
         unsq = lambda tree: jax.tree_util.tree_map(lambda x: x[None], tree)
         return SSPState(unsq(new_local), unsq(new_solver.history),
                         new_anchor, new_solver.it, unsq(new_error)), metrics
 
+    g = group_axis
+    batch_spec = P((dcn, axis)) if dcn else P(axis)
     sharded = jax.shard_map(
         device_step, mesh=mesh,
-        in_specs=(SSPState(P(axis), P(axis), P(), P(), P(axis)), P(axis), P()),
-        out_specs=(SSPState(P(axis), P(axis), P(), P(), P(axis)), P()),
+        in_specs=(SSPState(P(g), P(g), P(), P(), P(g)), batch_spec, P()),
+        out_specs=(SSPState(P(g), P(g), P(), P(), P(g)), P()),
         check_vma=False)
+    jitted = jax.jit(sharded, donate_argnums=(0,))
     return TrainStep(
-        step=jax.jit(sharded, donate_argnums=(0,)),
+        step=jitted,
         mesh=mesh,
-        batch_sharding=NamedSharding(mesh, P(axis)),
+        batch_sharding=NamedSharding(mesh, batch_spec),
         replicated=NamedSharding(mesh, P()),
+        lowerable=jitted,
     )
 
 

@@ -155,6 +155,14 @@ def _fold_padding(layer_nodes: List[Node]) -> List[Node]:
                             len(src.get_all("top")) != 1:
                         raise PrototxtError(
                             "padding layer must have one bottom and one top")
+                    # the consumer must be single-bottom too
+                    # (upgrade_proto.cpp CHECK_EQ(bottom_size(), 1)):
+                    # folding pad into a multi-input layer is undefined
+                    if len(conn.get_all("bottom")) != 1:
+                        raise PrototxtError(
+                            f"layer consuming padding output must have "
+                            f"exactly one bottom, got "
+                            f"{len(conn.get_all('bottom'))}")
                     lp.add("pad", src.get("layer").get("pad"))
                     new_conn.add("bottom", src.get("bottom"))
                 else:

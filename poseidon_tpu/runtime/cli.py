@@ -41,7 +41,9 @@ def _engine_from_args(args, phase_nets=True):
     sp = load_solver(args.solver)
     comm = CommConfig(default_strategy=args.strategy,
                       reduce=args.grad_reduce,
-                      topk_policy=getattr(args, "topk_policy", "magnitude"))
+                      topk_policy=getattr(args, "topk_policy", "magnitude"),
+                      wire_dtype=getattr(args, "wire_dtype", None) or None,
+                      topk_block=getattr(args, "topk_block", 0) or None)
     if args.sfb_auto:
         # same config, default strategy reset (auto_strategies fills in SFB)
         comm = dataclasses.replace(comm, default_strategy="dense")
@@ -400,6 +402,16 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["magnitude", "random", "fixed_order"],
                    help="which entries the TOPK budget sends (the server's "
                         "UpdateSortPolicy)")
+    t.add_argument("--wire_dtype", default="",
+                   choices=["", "f32", "bf16", "f16"],
+                   help="reduced-precision gradient exchange: cast grads to "
+                        "this dtype for every collective (DenseRowFloat16 "
+                        "analog); empty = exchange at gradient dtype")
+    t.add_argument("--topk_block", type=int, default=0,
+                   help="blocked top-k selection: pick top-k within blocks "
+                        "of this many elements instead of one global sort "
+                        "(row-granular, like the reference server); 0 = "
+                        "global top-k")
     t.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (MXU-native); params/updates stay "
                         "f32. Default f32 matches Caffe numerics exactly")

@@ -82,6 +82,10 @@ def layer_comm_table(
     comm = comm or CommConfig()
     cost = cost or CommCostModel()
     dtype_bytes = np.dtype(policy().compute_dtype).itemsize
+    # exchanged bytes ride the wire dtype when one is set (DenseRowFloat16
+    # analog); the dense-alternative baseline stays at the compute dtype
+    wd = comm.wire_jnp_dtype()  # validates the string
+    wire_bytes = np.dtype(wd).itemsize if wd is not None else dtype_bytes
 
     # accounting is purely static — accept a real Mesh OR a plain
     # {axis: size} dict, so hypothetical topologies need no physical devices
@@ -99,36 +103,41 @@ def layer_comm_table(
         strategy = comm.strategy_for(layer.name)
         param_count = sum(p.count for p in defs)
         param_bytes = param_count * dtype_bytes
+        sent_param_bytes = param_count * wire_bytes
         dense_ici = _allreduce_bytes(param_bytes, n_total if n_dcn == 1
                                      else n_ici)
         dense_dcn = _allreduce_bytes(param_bytes, n_dcn) if n_dcn > 1 else 0.0
+        sent_ici = _allreduce_bytes(sent_param_bytes, n_total if n_dcn == 1
+                                    else n_ici)
+        sent_dcn = (_allreduce_bytes(sent_param_bytes, n_dcn)
+                    if n_dcn > 1 else 0.0)
 
         ici_b = dcn_b = 0.0
         if strategy == DENSE:
-            ici_b, dcn_b = dense_ici, dense_dcn
+            ici_b, dcn_b = sent_ici, sent_dcn
         elif strategy == SFB:
             # factors: a = top diff (B_global, M), b = bottom data (B_global, K)
             wdef = next((p for p in defs if len(p.shape) == 2), None)
             if wdef is not None:
                 m, k = wdef.shape
                 b_global = net.blob_shapes[layer.lp.bottom[0]][0] * n_total
-                total = b_global * (m + k) * dtype_bytes
+                total = b_global * (m + k) * wire_bytes
                 ici_b = _allgather_bytes(total, n_total if n_dcn == 1
                                          else n_ici)
                 dcn_b = _allgather_bytes(total, n_dcn) if n_dcn > 1 else 0.0
                 # bias still rides a dense psum
                 bias = sum(p.count for p in defs) - m * k
-                ici_b += _allreduce_bytes(bias * dtype_bytes,
+                ici_b += _allreduce_bytes(bias * wire_bytes,
                                           n_total if n_dcn == 1 else n_ici)
             else:
-                ici_b, dcn_b = dense_ici, dense_dcn
+                ici_b, dcn_b = sent_ici, sent_dcn
         elif strategy == TOPK:
             k_entries = max(1, int(param_count * topk_fraction))
-            logical = k_entries * (cost.topk_index_bytes + dtype_bytes)
+            logical = k_entries * (cost.topk_index_bytes + wire_bytes)
             if n_dcn > 1:
                 # hierarchical: dense all-reduce intra-slice, compressed
                 # exchange inter-slice
-                ici_b = dense_ici
+                ici_b = sent_ici
                 dcn_b = _allreduce_bytes(logical, n_dcn)
             else:
                 ici_b = _allreduce_bytes(logical, n_total)

@@ -115,9 +115,11 @@ class Engine:
             # build_*_train_step snapshots the strategy map eagerly. SFB is a
             # per-step backward-time exchange, so under SSP (local steps, no
             # per-step exchange) the auto picks stay DENSE instead.
-            if staleness > 0:
-                log("sfb_auto: SFB does not compose with SSP staleness; "
-                    "keeping DENSE delta sync for all layers", rank=self.rank)
+            if staleness > 0 and self.comm.dcn_axis is None:
+                log("sfb_auto: SFB does not compose with flat-mesh SSP "
+                    "staleness; keeping DENSE delta sync for all layers "
+                    "(on a two-tier mesh SFB rides the intra-slice tier)",
+                    rank=self.rank)
             else:
                 from ..parallel.strategies import auto_strategies
                 self.comm.layer_strategies.update(
@@ -151,7 +153,10 @@ class Engine:
             self.train_step = TrainStep(
                 step=_ssp_step, mesh=ssp_ts.mesh,
                 batch_sharding=ssp_ts.batch_sharding,
-                replicated=ssp_ts.replicated)
+                replicated=ssp_ts.replicated,
+                # NOTE: the SSP lowerable has the 3-arg (state, batch, rng)
+                # signature, not the wrapper's 4-arg one
+                lowerable=ssp_ts.lowerable)
         else:
             dump = sorted({b for _, bs in self._h5_train for b in bs})
             self.train_step = build_train_step(self.train_net, sp, self.mesh,
@@ -166,7 +171,10 @@ class Engine:
         self.params = self.train_net.init(jax.random.fold_in(self.rng, 0))
         self.err_groups = comm_error_groups(self.comm, self.mesh)
         if staleness > 0:
-            self.state = init_ssp_state(self.params, self.n_dev, self.comm)
+            # SSP groups = slices on a two-tier mesh, devices on a flat one
+            # (the same granularity comm_error_groups computes)
+            self.state = init_ssp_state(self.params, self.err_groups,
+                                        self.comm)
         else:
             self.state = init_train_state(self.params, self.comm,
                                           self.err_groups)
@@ -246,15 +254,15 @@ class Engine:
         if path.endswith(".caffemodel"):
             self.params = load_caffemodel(path, self.train_net, self.params)
             if self.staleness > 0:
-                self.state = init_ssp_state(self.params, self.n_dev, self.comm)
+                self.state = init_ssp_state(self.params, self.err_groups,
+                                            self.comm)
             log(f"Loaded weights from {path}", rank=self.rank)
         else:
             from .checkpoint import coerce_state
             params, state = restore(path)
             self.params, self.state = coerce_state(
                 params, state, staleness=self.staleness,
-                n_dev=self.n_dev if self.staleness > 0 else self.err_groups,
-                comm=self.comm)
+                n_dev=self.err_groups, comm=self.comm)
             log(f"Restored solver state from {path} "
                 f"(iter {self.iteration()})", rank=self.rank)
 

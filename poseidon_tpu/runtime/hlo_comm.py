@@ -1,0 +1,149 @@
+"""Measured comm accounting: collectives extracted from the compiled step.
+
+The static table (comm_stats.py) predicts what each layer's strategy should
+move per step. This module closes the loop by reading what XLA *actually
+emitted*: the optimized HLO of the compiled train step, with every
+all-reduce / all-gather / reduce-scatter / collective-permute, its payload
+shape, dtype (so a bf16 wire is visible), and replica groups (so the
+ici/dcn tier split is visible). The analog of the reference's runtime stats
+(bg oplog bytes serialized, server push bytes — stats.hpp) for a compiled
+SPMD program, where the data plane is fixed at compile time.
+
+Usage:
+    compiled = ts.lowerable.lower(params, state, batch, rng).compile()
+    colls = parse_collectives(compiled.as_text())
+    summary = measured_comm_summary(colls, mesh_shape={"data": 8})
+    # -> totals comparable against comm_stats.comm_summary()
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+    "u64": 8, "u32": 4, "u16": 2, "u8": 1, "pred": 1,
+    "c64": 8, "c128": 16,
+}
+
+# e.g.:  %all-reduce.12 = f32[500,300]{1,0} all-reduce(...), replica_groups={{0,1},{2,3}}
+# XLA's combiner may merge several small collectives into one tuple-shaped
+# op: %ar = (f32[500,300]{1,0}, f32[500]{0}) all-reduce(...)
+_OP_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start)?\(")
+_SHAPE_RE = re.compile(r"\b(\w+)\[([\d,]*)\](?:\{[\d,]*\})?")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+
+
+@dataclass
+class Collective:
+    kind: str            # all-reduce | all-gather | ...
+    dtype: str           # dtype of the (first) payload
+    shape: tuple         # shape of the (first) payload
+    payload_bytes: int   # logical result payload (per participant, whole tuple)
+    group_size: int      # participants per replica group (1 = trivial)
+    n_groups: int
+
+    def wire_bytes_per_device(self) -> float:
+        """Bytes each participant moves, ring-algorithm convention (the same
+        convention comm_stats.py bills): all-reduce = 2(n-1)/n of payload,
+        all-gather/reduce-scatter = (n-1)/n of the full result, permute and
+        all-to-all = the shard itself."""
+        n = self.group_size
+        if n <= 1:
+            return 0.0
+        if self.kind == "all-reduce":
+            return 2.0 * (n - 1) / n * self.payload_bytes
+        if self.kind in ("all-gather", "reduce-scatter", "all-to-all"):
+            return (n - 1) / n * self.payload_bytes
+        return float(self.payload_bytes)  # collective-permute
+
+
+def parse_collectives(hlo_text: str) -> List[Collective]:
+    """All collectives in an (optimized) HLO module text, with payloads.
+
+    Start/done pairs are collapsed (only ``-start`` ops carry the payload;
+    plain ops appear in unoptimized HLO). Scalar payloads (e.g. the psum of
+    ones behind a mean) are kept — filter by payload_bytes if unwanted."""
+    out: List[Collective] = []
+    for line in hlo_text.splitlines():
+        if "-done" in line or " = " not in line:
+            continue
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group(1)
+        # payload = every dtype[dims] between "= " and the op keyword
+        # (a single shape, or the elements of a combined tuple)
+        lhs = line[line.index(" = ") + 3:m.start()]
+        payload = 0
+        first: Optional[tuple] = None
+        for dm in _SHAPE_RE.finditer(lhs):
+            dtype, dims = dm.group(1), dm.group(2)
+            if dtype not in _DTYPE_BYTES:
+                continue
+            shape = tuple(int(d) for d in dims.split(",")) if dims else ()
+            payload += (int(np.prod(shape)) if shape else 1) * \
+                _DTYPE_BYTES[dtype]
+            if first is None:
+                first = (dtype, shape)
+        if first is None:
+            continue
+        g = _GROUPS_RE.search(line)
+        gi = _IOTA_GROUPS_RE.search(line)
+        if g:
+            groups = [grp for grp in g.group(1).split("},{")]
+            group_size = len(groups[0].strip("{}").split(","))
+            n_groups = len(groups)
+        elif gi:  # iota form: replica_groups=[n_groups,group_size]<=[N]
+            n_groups, group_size = int(gi.group(1)), int(gi.group(2))
+        else:
+            group_size, n_groups = 1, 1
+        out.append(Collective(kind=kind, dtype=first[0], shape=first[1],
+                              payload_bytes=payload, group_size=group_size,
+                              n_groups=n_groups))
+    return out
+
+
+def measured_comm_summary(colls: List[Collective],
+                          min_payload_bytes: int = 16) -> Dict:
+    """Totals comparable against comm_stats.comm_summary(): per-device wire
+    bytes by collective kind and dtype, scalars filtered out."""
+    total = 0.0
+    by_kind: Dict[str, float] = {}
+    by_dtype: Dict[str, float] = {}
+    n_colls = 0
+    for c in colls:
+        if c.payload_bytes < min_payload_bytes or c.group_size <= 1:
+            continue
+        w = c.wire_bytes_per_device()
+        total += w
+        by_kind[c.kind] = by_kind.get(c.kind, 0.0) + w
+        by_dtype[c.dtype] = by_dtype.get(c.dtype, 0.0) + w
+        n_colls += 1
+    return {
+        "measured_bytes_per_step": int(total),
+        "n_collectives": n_colls,
+        "by_kind": {k: int(v) for k, v in sorted(by_kind.items())},
+        "by_dtype": {k: int(v) for k, v in sorted(by_dtype.items())},
+    }
+
+
+def compare_static_vs_measured(static_summary: Dict,
+                               measured: Dict) -> Dict:
+    """The validation row for docs/performance-guide.md: static prediction
+    vs compiled-program measurement and their ratio."""
+    s = float(static_summary.get("total_bytes_per_step", 0))
+    m = float(measured.get("measured_bytes_per_step", 0))
+    return {
+        "static_bytes_per_step": int(s),
+        "measured_bytes_per_step": int(m),
+        "measured_over_static": round(m / s, 4) if s else None,
+    }

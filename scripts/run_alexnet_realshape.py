@@ -1,0 +1,114 @@
+"""Run one zoo model at its REAL shape: AlexNet (B, 3, 227, 227) train step.
+
+Round-2 verdict weak #7: every zoo model had only ever been exercised at
+tiny synthetic dims; compile-time, layout, and memory behavior at the
+reference benchmark shape (models/bvlc_alexnet/train_val.prototxt: batch
+256, crop 227) was untested. This script compiles and runs a few steps of
+the full AlexNet training step at real spatial shape on whatever backend is
+available, recording compile time, step time, and peak memory.
+
+Prints ONE JSON line. On CPU the batch defaults down to 32 (a 1-core CPU
+cannot turn over batch-256 conv stacks in reasonable time; the 227x227
+spatial dims and all parameter shapes — the things that break — stay real).
+
+Usage:
+  python scripts/run_alexnet_realshape.py [--batch N] [--steps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=0,
+                    help="per-device batch; 0 = 256 on TPU, 32 on CPU")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--bf16", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from poseidon_tpu import config
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import (CommConfig, SFB, build_train_step,
+                                       init_train_state, make_mesh)
+    from poseidon_tpu.proto.messages import SolverParameter
+
+    backend = jax.default_backend()
+    per_dev = args.batch or (256 if backend == "tpu" else 32)
+    if args.bf16 or backend == "tpu":
+        config.set_policy(compute_dtype=jnp.bfloat16)
+
+    n_dev = jax.device_count()
+    mesh = make_mesh()
+    net_param = zoo.alexnet(num_classes=1000, with_accuracy=False)
+    shapes = {"data": (per_dev, 3, 227, 227), "label": (per_dev,)}
+    net = Net(net_param, phase="TRAIN", source_shapes=shapes)
+    sp = SolverParameter(base_lr=0.01, lr_policy="step", gamma=0.1,
+                         stepsize=100000, momentum=0.9, weight_decay=5e-4)
+    comm = CommConfig(layer_strategies={"fc6": SFB, "fc7": SFB})
+    ts = build_train_step(net, sp, mesh, comm, donate=True)
+    params = net.init(jax.random.PRNGKey(0))
+    state = init_train_state(params, comm, n_dev)
+    rs = np.random.RandomState(0)
+    batch = {
+        "data": jnp.asarray(
+            rs.rand(per_dev * n_dev, 3, 227, 227).astype(np.float32),
+            device=ts.batch_sharding),
+        "label": jnp.asarray(rs.randint(0, 1000, size=(per_dev * n_dev,)),
+                             device=ts.batch_sharding),
+    }
+
+    t0 = time.perf_counter()
+    params, state, m = ts.step(params, state, batch, jax.random.PRNGKey(1))
+    jax.block_until_ready(m["loss"])
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, state, m = ts.step(params, state, batch,
+                                   jax.random.PRNGKey(2))
+    jax.block_until_ready(m["loss"])
+    step_s = (time.perf_counter() - t0) / args.steps
+
+    peak = {}
+    try:
+        ms = jax.devices()[0].memory_stats()
+        if ms:
+            peak["device_peak_bytes"] = int(ms.get("peak_bytes_in_use", 0))
+    except Exception:  # noqa: BLE001
+        pass
+    peak["host_peak_rss_mb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+    print(json.dumps({
+        "metric": "alexnet_realshape_step_ms",
+        "value": round(step_s * 1e3, 1),
+        "unit": "ms",
+        "backend": backend,
+        "n_devices": n_dev,
+        "per_device_batch": per_dev,
+        "image": 227,
+        "classes": 1000,
+        "compile_s": round(compile_s, 1),
+        "images_per_sec": round(per_dev * n_dev / step_s, 1),
+        "loss": float(m["loss"]),
+        **peak,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

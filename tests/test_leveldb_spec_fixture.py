@@ -303,3 +303,138 @@ def test_convert_db_from_spec_fixture(stock_like_db, tmp_path):
     assert n == len(want)
     lr = LMDBReader(out)
     assert {lr.key_at(i): lr.value_at(i) for i in range(len(lr))} == want
+
+
+# ------------------- multi-level compacted database ----------------------- #
+
+def version_edit_cp(compact_pointers=(), **kw) -> bytes:
+    """version_edit + tag-5 compact pointers (level, internal key) — present
+    in any MANIFEST that has survived a compaction."""
+    out = bytearray(version_edit(**kw))
+    for level, ik in compact_pointers:
+        out += varint(5) + varint(level) + varint(len(ik)) + ik
+    return bytes(out)
+
+
+@pytest.fixture()
+def multilevel_db(tmp_path):
+    """A database shaped like stock LevelDB after real compaction traffic:
+
+    - a bottom level-2 run whose entries carry sequence 0 (leveldb zeroes
+      the sequence of bottom-level keys during compaction when no snapshot
+      needs them — db/version_set semantics)
+    - a level-1 run holding a tombstone for a key whose value lives below
+      it, plus an overwrite shadowing a level-2 value
+    - two OVERLAPPING level-0 files (level 0 is the only level allowed to
+      overlap) where the same user key appears in both — highest sequence
+      must win regardless of file scan order
+    - delete-then-reinsert across levels: value@L2, tombstone@L1,
+      new value@L0 — the key must be PRESENT with the newest value
+    - a WAL overwriting and deleting on top of all levels
+    - a MANIFEST with multi-record compaction history: comparator, compact
+      pointers, an obsolete level-1 file deleted by a later edit but still
+      on disk (must be ignored)
+    """
+    db = tmp_path / "db"
+    db.mkdir()
+
+    # bottom level 2: sequences zeroed by compaction
+    l2 = [
+        (ikey(b"alpha", 0), b"a-bottom"),
+        (ikey(b"dead", 0), b"should-die"),
+        (ikey(b"ghost", 0), b"g-old"),
+        (ikey(b"keep", 0), b"base"),
+        (ikey(b"over", 0), b"old"),
+    ]
+    write_sstable(str(db / "000011.ldb"), l2, split_at=3)
+
+    # level 1: tombstone for 'ghost' + overwrite of 'over' + new 'lime'
+    l1 = [
+        (ikey(b"ghost", 20, TYPE_DELETION), b""),
+        (ikey(b"lime", 22), b"green"),
+        (ikey(b"over", 21), b"mid"),
+    ]
+    write_sstable(str(db / "000013.ldb"), l1, compress_second=False)
+
+    # overlapping level-0 files: same user key in both, newer seq wins;
+    # 000017 also re-inserts 'ghost' ABOVE the level-1 tombstone
+    l0_old = [
+        (ikey(b"alpha", 40), b"a0-old"),
+        (ikey(b"dead", 41, TYPE_DELETION), b""),
+    ]
+    write_sstable(str(db / "000015.ldb"), l0_old, compress_second=False)
+    l0_new = [
+        (ikey(b"alpha", 60), b"a0-new"),
+        (ikey(b"ghost", 61), b"resurrected"),
+    ]
+    write_sstable(str(db / "000017.ldb"), l0_new, compress_second=False)
+
+    # an LDB compacted away but still on disk: wrong values for everything
+    write_sstable(str(db / "000009.ldb"),
+                  [(ikey(b"alpha", 5), b"WRONG-OBSOLETE")],
+                  compress_second=False)
+
+    # MANIFEST: three edits — creation, compaction to levels, L0 additions
+    mw = LogWriter(str(db / "MANIFEST-000020"))
+    mw.add(version_edit_cp(comparator=b"leveldb.BytewiseComparator",
+                           log_number=8, next_file=12, last_seq=10,
+                           new_files=[(1, 9, 64, ikey(b"alpha", 5),
+                                       ikey(b"alpha", 5))]))
+    mw.add(version_edit_cp(log_number=14, next_file=16, last_seq=30,
+                           deleted_files=[(1, 9)],
+                           new_files=[(2, 11, 256, l2[0][0], l2[-1][0]),
+                                      (1, 13, 128, l1[0][0], l1[-1][0])],
+                           compact_pointers=[(1, ikey(b"over", 21)),
+                                             (2, ikey(b"over", 0))]))
+    mw.add(version_edit_cp(log_number=18, next_file=21, last_seq=61,
+                           new_files=[(0, 15, 64, l0_old[0][0],
+                                       l0_old[-1][0]),
+                                      (0, 17, 64, l0_new[0][0],
+                                       l0_new[-1][0])]))
+    mw.close()
+    (db / "CURRENT").write_text("MANIFEST-000020\n")
+
+    # live WAL on top of all levels
+    lw = LogWriter(str(db / "000018.log"))
+    lw.add(write_batch(70, [("put", b"keep", b"fresh"),
+                            ("del", b"lime")]))
+    lw.close()
+    # superseded WAL (< log_number 18), still on disk
+    lw2 = LogWriter(str(db / "000008.log"))
+    lw2.add(write_batch(1, [("put", b"keep", b"WRONG-OLD-WAL")]))
+    lw2.close()
+
+    want = {
+        b"alpha": b"a0-new",       # overlapping-L0 race: seq 60 beats 40, 0
+        b"ghost": b"resurrected",  # value@L2 < tombstone@L1 < value@L0
+        b"keep": b"fresh",         # WAL overwrite of a seq-0 bottom entry
+        b"over": b"mid",           # L1 shadows L2
+    }                              # dead: L0 tombstone kills L2 value
+                                   # lime: WAL tombstone kills L1 value
+    return str(db), want
+
+
+def test_reader_multilevel_compacted(multilevel_db):
+    path, want = multilevel_db
+    r = LevelDBReader(path)
+    got = dict(iter(r))
+    assert got == want
+    assert len(r) == len(want)
+    # deleted keys are really gone, not empty
+    for k in (b"dead", b"lime"):
+        assert k not in got
+
+
+def test_multilevel_tables_accepted_by_convert(multilevel_db, tmp_path):
+    """The merged multi-level view round-trips through the LMDB converter
+    path (convert_db uses the reader's sorted iteration)."""
+    from poseidon_tpu.data.lmdb_reader import LMDBReader, LMDBWriter
+    path, want = multilevel_db
+    out = tmp_path / "out_lmdb"
+    w = LMDBWriter(str(out))
+    for k, v in LevelDBReader(path):
+        w.put(k, v)
+    w.close()
+    r = LMDBReader(str(out))
+    assert {r.key_at(i): r.value_at(i)
+            for i in range(len(r))} == want

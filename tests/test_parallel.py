@@ -444,3 +444,230 @@ def test_bandwidth_budget_derives_topk_fraction(lenet_net):
     assert frac == pytest.approx(0.1e6 / 8.0 / total, rel=1e-6)
     # no budget -> configured fraction
     assert budget_topk_fraction(lenet_net, CommConfig()) == 0.01
+
+
+# --------------------------------------------------------------------------- #
+# Reduced-precision wire (DenseRowFloat16 analog) + blocked top-k
+# --------------------------------------------------------------------------- #
+
+def test_wire_dtype_bf16_converges_close_to_f32(mesh, lenet_net, rng_np):
+    """bf16 gradient exchange must track full-precision training closely —
+    the DenseRowFloat16 trade (dense_row_float16.hpp:10-16), compiled."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    n_iters = 10
+
+    f32 = build_train_step(lenet_net, sp, mesh, CommConfig(), donate=False)
+    p1, s1 = params, init_train_state(params)
+    for i in range(n_iters):
+        p1, s1, m1 = f32.step(p1, s1, batch, jax.random.PRNGKey(i))
+
+    cc = CommConfig(wire_dtype="bf16")
+    bw = build_train_step(lenet_net, sp, mesh, cc, donate=False)
+    p2, s2 = params, init_train_state(params, cc, N_DEV)
+    for i in range(n_iters):
+        p2, s2, m2 = bw.step(p2, s2, batch, jax.random.PRNGKey(i))
+
+    start = float(np.log(10))
+    l1, l2 = float(m1["loss"]), float(m2["loss"])
+    assert l1 < 0.7 * start
+    # within a third of full-precision progress despite half-width wire
+    assert l2 < l1 + 0.33 * (start - l1), f"bf16 wire {l2} vs f32 {l1}"
+    for l in p1:
+        for k in p1[l]:
+            np.testing.assert_allclose(
+                np.asarray(p1[l][k]), np.asarray(p2[l][k]),
+                rtol=0.1, atol=5e-3, err_msg=f"{l}/{k}")
+
+
+def test_wire_dtype_lowers_bf16_collectives(mesh, lenet_net, rng_np):
+    """The compiled step must actually carry bf16 operands into the
+    collectives (not cast after): check the lowered module text."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed")
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = CommConfig(wire_dtype="bf16")
+    ts = build_train_step(lenet_net, sp, mesh, cc, donate=False)
+    state = init_train_state(params, cc, N_DEV)
+    text = ts.lowerable.lower(params, state, batch,
+                              jax.random.PRNGKey(0)).as_text()
+    assert "bf16" in text
+    # the f32 build has no bf16 anywhere (compute dtype is f32 in tests)
+    ts0 = build_train_step(lenet_net, sp, mesh, CommConfig(), donate=False)
+    t0 = ts0.lowerable.lower(params, init_train_state(params), batch,
+                             jax.random.PRNGKey(0)).as_text()
+    assert "bf16" not in t0
+
+
+def test_wire_dtype_sfb_and_topk(mesh, lenet_net, rng_np):
+    """wire_dtype composes with SFB (factors gathered at bf16) and TOPK
+    (values quantized into the error-feedback residual)."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = CommConfig(wire_dtype="bf16",
+                    layer_strategies={"ip1": SFB, "ip2": SFB,
+                                      "conv1": "topk", "conv2": "topk"},
+                    topk_fraction=0.2)
+    ts = build_train_step(lenet_net, sp, mesh, cc, donate=False)
+    p, s = params, init_train_state(params, cc, N_DEV)
+    losses = []
+    for i in range(8):
+        p, s, m = ts.step(p, s, batch, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_wire_dtype_ssp(mesh, lenet_net, rng_np):
+    """wire_dtype applies to the SSP delta exchange at sync boundaries."""
+    from poseidon_tpu.parallel import build_ssp_train_step, init_ssp_state
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = CommConfig(wire_dtype="bf16")
+    ts = build_ssp_train_step(lenet_net, sp, mesh, staleness=1, comm=cc)
+    s = init_ssp_state(params, N_DEV, cc)
+    losses = []
+    for i in range(8):
+        s, m = ts.step(s, batch, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_blocked_topk_matches_global_budget():
+    """Blocked selection keeps >= the global-k budget, selects the per-block
+    maxima, and feeds the complement into the residual."""
+    from poseidon_tpu.parallel.strategies import topk_compress
+    rng = np.random.RandomState(0)
+    g = jnp.asarray(rng.randn(1000).astype(np.float32))
+    err = jnp.zeros(1000, jnp.float32)
+    sent, resid = topk_compress(g, 0.01, err, "magnitude", block=100)
+    nz = np.asarray(sent) != 0
+    # ceil(10/10) = 1 per block x 10 blocks = 10 entries
+    assert nz.sum() == 10
+    # each block's winner is that block's max-|g| entry
+    ga = np.asarray(g).reshape(10, 100)
+    for b in range(10):
+        w = np.abs(ga[b]).argmax()
+        assert nz.reshape(10, 100)[b, w]
+    np.testing.assert_allclose(np.asarray(sent + resid), np.asarray(g),
+                               rtol=1e-6)
+
+
+def test_blocked_topk_nondivisible_and_training(mesh, lenet_net, rng_np):
+    """Padding path (size not a multiple of block) + end-to-end training."""
+    from poseidon_tpu.parallel.strategies import topk_compress
+    g = jnp.asarray(np.random.RandomState(1).randn(103).astype(np.float32))
+    sent, resid = topk_compress(g, 0.1, jnp.zeros(103), "magnitude",
+                                block=25)
+    np.testing.assert_allclose(np.asarray(sent + resid), np.asarray(g),
+                               rtol=1e-6)
+    assert (np.asarray(sent) != 0).sum() >= 10
+
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = CommConfig(default_strategy="topk", topk_fraction=0.1,
+                    topk_block=256)
+    ts = build_train_step(lenet_net, sp, mesh, cc, donate=False)
+    p, s = params, init_train_state(params, cc, N_DEV)
+    losses = []
+    for i in range(10):
+        p, s, m = ts.step(p, s, batch, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_random_topk_decorrelated_across_layers():
+    """Same-shaped tensors in different layers must select different random
+    subsets (the per-table independence of the reference's Random policy)."""
+    from poseidon_tpu.parallel.strategies import comm_salt, topk_compress
+    g = jnp.ones(1000)
+    err = jnp.zeros(1000)
+    s1, _ = topk_compress(g, 0.05, err, "random", step=3,
+                          salt=comm_salt("conv1", "w"))
+    s2, _ = topk_compress(g, 0.05, err, "random", step=3,
+                          salt=comm_salt("conv2", "w"))
+    nz1 = np.flatnonzero(np.asarray(s1))
+    nz2 = np.flatnonzero(np.asarray(s2))
+    assert not np.array_equal(nz1, nz2)
+
+
+# --------------------------------------------------------------------------- #
+# SSP x two-tier mesh: staleness on the DCN tier, dense ICI tier every step
+# (the SSPAggr deployment: full-rate intra-machine, managed inter-machine)
+# --------------------------------------------------------------------------- #
+
+def test_ssp_two_tier_slices_sync_on_boundary(two_tier_mesh, lenet_net,
+                                              rng_np):
+    """With staleness on the DCN tier, the two slices diverge between syncs
+    and reconcile exactly at the boundary; devices inside a slice see the
+    same slice-local params throughout."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = _two_tier_cc()
+    ts = build_ssp_train_step(lenet_net, sp, two_tier_mesh, staleness=1,
+                              comm=cc)
+    st = init_ssp_state(params, 2, cc)  # 2 slices
+    for i in range(1, 5):
+        st, m = ts.step(st, batch, jax.random.PRNGKey(i))
+        local = np.asarray(st.local_params["conv1"]["w"])  # (2, ...)
+        diverged = np.abs(local[0] - local[1]).max()
+        if i % 2 == 0:  # sync boundary: slices reconciled
+            assert diverged == 0.0, f"iter {i}: slices differ by {diverged}"
+        else:           # mid-period: slices have diverged (different shards)
+            assert diverged > 0.0, f"iter {i}: slices did not diverge"
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_ssp_two_tier_with_sfb_and_topk(two_tier_mesh, lenet_net, rng_np):
+    """The full SSPAggr composition: SFB FC layers ride the per-step ICI
+    tier, conv layers TOPK-compress their deltas across the DCN tier, all
+    under staleness 1 — and training still converges."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = _two_tier_cc(layer_strategies={"ip1": SFB, "ip2": SFB,
+                                        "conv1": "topk", "conv2": "topk"},
+                      topk_fraction=0.2)
+    ts = build_ssp_train_step(lenet_net, sp, two_tier_mesh, staleness=1,
+                              comm=cc)
+    st = init_ssp_state(params, 2, cc)
+    assert "conv1" in st.comm_error and "ip1" not in st.comm_error
+    losses = []
+    for i in range(10):
+        st, m = ts.step(st, batch, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # TOPK residuals hold unsent delta mass after a sync
+    assert np.abs(np.asarray(st.comm_error["conv1"]["w"])).max() > 0
+
+
+def test_ssp_two_tier_staleness0_matches_sync(two_tier_mesh, lenet_net,
+                                              rng_np):
+    """staleness=0 over the two-tier mesh must equal the fully-synchronous
+    two-tier step: every step reconciles, so no divergence survives."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = _two_tier_cc()
+    sync = build_train_step(lenet_net, sp, two_tier_mesh, cc, donate=False)
+    p1, s1 = params, init_train_state(params, cc, 2)
+    ssp = build_ssp_train_step(lenet_net, sp, two_tier_mesh, staleness=0,
+                               comm=cc)
+    st = init_ssp_state(params, 2, cc)
+    for i in range(3):
+        p1, s1, m1 = sync.step(p1, s1, batch, jax.random.PRNGKey(9))
+        st, m2 = ssp.step(st, batch, jax.random.PRNGKey(9))
+    assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), rel=1e-4)
+    for l in p1:
+        for k in p1[l]:
+            np.testing.assert_allclose(
+                np.asarray(p1[l][k]), np.asarray(st.anchor_params[l][k]),
+                rtol=1e-3, atol=1e-5, err_msg=f"{l}/{k}")
