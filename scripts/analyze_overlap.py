@@ -1,0 +1,134 @@
+"""DWBP overlap proof from an xplane trace: do collectives co-run with compute?
+
+The reference's signature result is that per-layer gradient sync threads
+overlap communication with the remaining backward pass
+(/root/reference/src/caffe/solver.cpp:419-449). Our rebuild emits the psums
+mid-backward via custom_vjp taps and relies on XLA's latency-hiding
+scheduler to overlap them. bench.py's DENSE vs DENSE_FUSED A/B measures the
+end-to-end win; THIS script proves the mechanism from the trace: for every
+collective op on the device timeline, how much of its duration co-runs with
+at least one compute op.
+
+Usage: python scripts/analyze_overlap.py <trace_dir>
+       (trace_dir = what POSEIDON_BENCH_TRACE / --profile wrote; the newest
+        plugins/profile/*/ *.xplane.pb inside it is used)
+
+Prints ONE JSON line:
+  {"metric": "dwbp_overlap_fraction", "value": 0..1,
+   "collective_ms": N, "overlapped_ms": N, "n_collectives": N, ...}
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+# HLO instruction names keep the jax primitive's label (psum.N, all_gather.N)
+# as well as XLA's own collective spellings
+COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "all_gather", "psum",
+                      "reduce-scatter", "reduce_scatter",
+                      "collective-permute", "collective_permute",
+                      "all-to-all", "all_to_all", "ppermute")
+
+
+def find_xplane(trace_dir: str) -> str:
+    pats = [os.path.join(trace_dir, "**", "*.xplane.pb")]
+    hits = []
+    for p in pats:
+        hits += glob.glob(p, recursive=True)
+    if not hits:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    return max(hits, key=os.path.getmtime)
+
+
+def load_device_events(path: str):
+    """-> list of (name, start_ps, dur_ps) from device-side xlines."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:  # proto location moved across TF versions
+        from xprof.protobuf import xplane_pb2  # type: ignore
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    def plane_events(plane):
+        emeta = {k: v.name for k, v in plane.event_metadata.items()}
+        out = []
+        for line in plane.lines:
+            for ev in line.events:
+                name = emeta.get(ev.metadata_id, "")
+                start = line.timestamp_ns * 1000 + ev.offset_ps
+                out.append((name, start, ev.duration_ps))
+        return out
+
+    device, rest = [], []
+    for plane in xs.planes:
+        pname = plane.name.lower()
+        is_device = ("tpu" in pname or "device" in pname) and \
+            "host" not in pname
+        (device if is_device else rest).append(plane)
+    events = [e for p in device for e in plane_events(p)]
+    if not events:  # CPU smoke traces have only host planes
+        events = [e for p in rest for e in plane_events(p)]
+    return events
+
+
+def overlap_fraction(events) -> dict:
+    # drop python-frame ("$...") and paired end-marker host events
+    events = [(n, s, d) for n, s, d in events
+              if n and not n.startswith(("$", "end:"))]
+    colls = [(s, s + d, n) for n, s, d in events
+             if any(m in n.lower() for m in COLLECTIVE_MARKERS) and d > 0]
+    comp = sorted((s, s + d) for n, s, d in events
+                  if d > 0 and
+                  not any(m in n.lower() for m in COLLECTIVE_MARKERS))
+    # merge compute intervals
+    merged = []
+    for s, e in comp:
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+
+    import bisect
+    starts = [m[0] for m in merged]
+
+    def covered(a: float, b: float) -> float:
+        tot = 0.0
+        i = bisect.bisect_right(starts, a) - 1
+        i = max(i, 0)
+        while i < len(merged) and merged[i][0] < b:
+            s, e = merged[i]
+            tot += max(0.0, min(e, b) - max(s, a))
+            i += 1
+        return tot
+
+    total = sum(e - s for s, e, _ in colls)
+    over = sum(covered(s, e) for s, e, _ in colls)
+    return {
+        "metric": "dwbp_overlap_fraction",
+        "value": round(over / total, 4) if total else None,
+        "collective_ms": round(total / 1e9, 3),
+        "overlapped_ms": round(over / 1e9, 3),
+        "n_collectives": len(colls),
+        "n_compute_events": len(comp),
+    }
+
+
+def main() -> int:
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "evidence/xplane"
+    try:
+        path = find_xplane(trace_dir)
+        events = load_device_events(path)
+        out = overlap_fraction(events)
+        out["xplane"] = path
+    except Exception as e:  # noqa: BLE001
+        out = {"metric": "dwbp_overlap_fraction", "value": None,
+               "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(out), flush=True)
+    return 0 if out.get("value") is not None else 1
+
+
+if __name__ == "__main__":
+    main()

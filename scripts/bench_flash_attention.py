@@ -1,0 +1,108 @@
+"""Flash (Pallas) vs dense (XLA) attention timings at S in {1k, 4k, 16k}.
+
+Round-2 verdict item 3: the Pallas kernels had only ever run in interpret
+mode; this script Mosaic-compiles them on the real backend and produces the
+flash-vs-XLA table (forward and forward+backward), including the regime
+where the dense op's (S, S) score matrix stops fitting HBM and flash keeps
+going — the long-context capability the kernels exist for.
+
+Prints one JSON line per (S, impl, pass) plus a final summary line.
+CPU smoke: POSEIDON_FLASH_CPU=1 runs tiny shapes in interpret mode (wiring
+check only; the timings are meaningless off-TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    cpu = os.environ.get("POSEIDON_FLASH_CPU", "") == "1"
+    if cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    from poseidon_tpu.ops.attention import attention
+    from poseidon_tpu.ops.pallas_kernels import flash_attention, pick_block
+
+    backend = jax.default_backend()
+    if backend != "tpu" and not cpu:
+        print(json.dumps({"error": f"backend is {backend!r}; flash timings "
+                          f"need TPU (set POSEIDON_FLASH_CPU=1 for a "
+                          f"wiring smoke)"}), flush=True)
+        sys.exit(1)
+
+    seqs = [256] if cpu else [1024, 4096, 16384]
+    B, H, D = 1, 8, 128
+    dtype = jnp.float32 if cpu else jnp.bfloat16
+    iters = 2 if cpu else 10
+    rows = []
+
+    for S in seqs:
+        rs = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rs.randn(B, H, S, D), dtype) * 0.1
+                   for _ in range(3))
+        blk = pick_block(S) or 32
+
+        def time_fn(fn, *args):
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        impls = {
+            "flash": jax.jit(lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, True, None, blk, blk, None if not cpu else True)),
+            "dense": jax.jit(lambda q_, k_, v_: attention(
+                q_, k_, v_, causal=True)),
+        }
+        grads = {
+            name: jax.jit(jax.grad(
+                lambda q_, k_, v_, f=fn: jnp.sum(f(q_, k_, v_) ** 2)))
+            for name, fn in impls.items()
+        }
+        for name in impls:
+            row = {"seq": S, "impl": name}
+            try:
+                row["fwd_ms"] = round(time_fn(impls[name], q, k, v), 3)
+                row["fwd_bwd_ms"] = round(time_fn(grads[name], q, k, v), 3)
+            except Exception as e:  # noqa: BLE001 — dense OOMs at long S
+                row["error"] = f"{type(e).__name__}: {str(e)[:160]}"
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    by_seq = {}
+    for r in rows:
+        by_seq.setdefault(r["seq"], {})[r["impl"]] = r
+    summary = {"metric": "flash_vs_xla_attention", "backend": backend,
+               "table": []}
+    for S, d in sorted(by_seq.items()):
+        f, x = d.get("flash", {}), d.get("dense", {})
+        entry = {"seq": S,
+                 "flash_fwd_ms": f.get("fwd_ms"),
+                 "dense_fwd_ms": x.get("fwd_ms"),
+                 "flash_fwd_bwd_ms": f.get("fwd_bwd_ms"),
+                 "dense_fwd_bwd_ms": x.get("fwd_bwd_ms")}
+        if f.get("fwd_bwd_ms") and x.get("fwd_bwd_ms"):
+            entry["flash_speedup_fwd_bwd"] = round(
+                x["fwd_bwd_ms"] / f["fwd_bwd_ms"], 2)
+        if x.get("error"):
+            entry["dense_error"] = x["error"]
+        summary["table"].append(entry)
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

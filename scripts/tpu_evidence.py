@@ -1,0 +1,115 @@
+"""One-shot TPU evidence capture: run EVERYTHING the moment the tunnel is up.
+
+The axon tunnel flaps for hours (two full rounds lost); when a window
+opens, a single command must capture every piece of hardware evidence the
+project needs, ordered most-important-first so a mid-run flap still leaves
+the headline numbers behind:
+
+1. bench.py                      -> BENCH JSON + BENCH_last_good.json
+                                    (images/s, MFU, DWBP A/B, NHWC A/B,
+                                    topk cost, LM tokens/s) + xplane trace
+2. Mosaic compile of the Pallas kernels (tests/test_pallas.py with
+   interpret=False on real TPU) + flash-vs-XLA attention timings at
+   S in {1k, 4k, 16k}
+3. AlexNet at REAL shape (256, 3, 227, 227) step + memory
+4. DWBP overlap proof from the captured xplane: fraction of collective
+   time that co-runs with compute (scripts/analyze_overlap.py)
+
+Everything lands in evidence/ (JSON + logs); a summary is appended to
+evidence/EVIDENCE.md. Run directly or via scripts/tpu_watch.py --evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVID = os.path.join(REPO, "evidence")
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%d %H:%M:%S")
+
+
+def _run(name: str, cmd: list, env: dict | None = None,
+         timeout: float = 1800) -> dict:
+    print(f"[{_now()}] {name}: {' '.join(cmd)}", flush=True)
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO, env=e)
+        out = {"name": name, "rc": r.returncode,
+               "seconds": round(time.time() - t0, 1),
+               "stdout_tail": r.stdout.strip().splitlines()[-12:],
+               "stderr_tail": r.stderr.strip().splitlines()[-6:]}
+    except subprocess.TimeoutExpired:
+        out = {"name": name, "rc": -9, "seconds": round(time.time() - t0, 1),
+               "error": f"timed out after {timeout}s (tunnel flap?)"}
+    log_path = os.path.join(EVID, f"{name}.json")
+    with open(log_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"[{_now()}] {name}: rc={out['rc']} ({out['seconds']}s)",
+          flush=True)
+    return out
+
+
+def main() -> int:
+    os.makedirs(EVID, exist_ok=True)
+    trace_dir = os.path.join(EVID, "xplane")
+    results = []
+
+    # 1 — the headline bench, with trace capture for the overlap analysis
+    results.append(_run(
+        "bench", [sys.executable, "bench.py"],
+        env={"POSEIDON_BENCH_TRACE": trace_dir,
+             "POSEIDON_BENCH_BUDGET_S": "1500"},
+        timeout=2400))
+
+    # 2 — Mosaic-compile the Pallas kernels on hardware (the conftest pins
+    # CPU unless POSEIDON_TEST_TPU=1; on the tpu backend interpret=False is
+    # the kernels' default, i.e. real Mosaic compilation)
+    results.append(_run(
+        "pallas_mosaic",
+        [sys.executable, "-m", "pytest", "tests/test_pallas.py", "-q",
+         "--no-header"],
+        env={"POSEIDON_TEST_TPU": "1"},
+        timeout=1800))
+
+    # 2b — flash-vs-XLA attention table
+    results.append(_run(
+        "flash_vs_xla",
+        [sys.executable, "scripts/bench_flash_attention.py"],
+        timeout=1800))
+
+    # 3 — real-shape AlexNet
+    results.append(_run(
+        "alexnet_realshape",
+        [sys.executable, "scripts/run_alexnet_realshape.py", "--steps", "3"],
+        timeout=1800))
+
+    # 4 — overlap proof from the trace
+    results.append(_run(
+        "dwbp_overlap",
+        [sys.executable, "scripts/analyze_overlap.py", trace_dir],
+        timeout=600))
+
+    ok = sum(1 for r in results if r["rc"] == 0)
+    with open(os.path.join(EVID, "EVIDENCE.md"), "a") as f:
+        f.write(f"\n## Capture at {_now()} — {ok}/{len(results)} sections ok\n\n")
+        for r in results:
+            f.write(f"- **{r['name']}**: rc={r['rc']} ({r['seconds']}s)\n")
+            for line in r.get("stdout_tail", [])[-3:]:
+                f.write(f"    - `{line[:200]}`\n")
+    print(f"[{_now()}] evidence capture: {ok}/{len(results)} ok", flush=True)
+    return 0 if ok == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,11 @@ def main() -> None:
     ap.add_argument("--probe_timeout", type=float, default=120.0)
     ap.add_argument("--once", action="store_true",
                     help="exit after the first successful bench run")
+    ap.add_argument("--evidence", action="store_true",
+                    help="run the full evidence capture "
+                         "(scripts/tpu_evidence.py) instead of bench.py "
+                         "alone: bench + Mosaic pallas + flash table + "
+                         "real-shape AlexNet + overlap proof")
     args = ap.parse_args()
 
     while True:
@@ -51,11 +56,13 @@ def main() -> None:
         else:
             print(f"[{_now()}] tunnel UP: {info} — running bench",
                   flush=True)
+            target = (os.path.join(REPO, "scripts", "tpu_evidence.py")
+                      if args.evidence else os.path.join(REPO, "bench.py"))
             try:
                 r = subprocess.run(
-                    [sys.executable, os.path.join(REPO, "bench.py")],
+                    [sys.executable, target],
                     capture_output=True, text=True,
-                    timeout=3600, cwd=REPO)
+                    timeout=3600 if not args.evidence else 9000, cwd=REPO)
             except subprocess.TimeoutExpired:
                 # tunnel flapped mid-bench; the watcher must outlive it
                 print(f"[{_now()}] bench hung past 3600s; will retry",

@@ -7,11 +7,17 @@ no TPU pod required.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# POSEIDON_TEST_TPU=1 runs the suite against the real TPU backend instead
+# of the virtual CPU mesh — used by scripts/tpu_evidence.py to
+# Mosaic-compile the Pallas kernels on hardware (tests/test_pallas.py).
+_ON_TPU = os.environ.get("POSEIDON_TEST_TPU", "") == "1"
+
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
@@ -19,7 +25,8 @@ import jax  # noqa: E402
 # jax_platforms="axon,cpu" via jax.config, which overrides the env var and
 # would route these CPU-mesh tests at a (possibly absent) TPU tunnel. Force
 # the config back to cpu-only before any backend is initialized.
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
