@@ -241,8 +241,11 @@ class LRNLayer(Layer):
     def apply(self, params, bottoms, ctx):
         x = bottoms[0]
         if self.region == "ACROSS_CHANNELS":
-            return [NN.lrn_across_channels(x, self.local_size, self.alpha,
-                                           self.beta, self.k)]
+            # on real TPU this takes the fused Pallas kernel (one VMEM pass);
+            # XLA formulation elsewhere — identical numerics either way
+            from ..ops.pallas_kernels import maybe_lrn_fused
+            return [maybe_lrn_fused(x, self.local_size, self.alpha,
+                                    self.beta, self.k)]
         return [NN.lrn_within_channel(x, self.local_size, self.alpha, self.beta)]
 
 

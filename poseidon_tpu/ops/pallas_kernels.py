@@ -413,9 +413,8 @@ def _lrn_kernel(x_ref, o_ref, *, local_size: int, alpha: float, beta: float,
     o_ref[0] = (x * scale ** (-beta)).astype(o_ref.dtype)
 
 
-def lrn_fused(x, local_size: int, alpha: float, beta: float, k: float = 1.0,
-              tile: int = 512, interpret: Optional[bool] = None):
-    """Fused LRN forward: x (N, C, H, W). Differentiable via recompute VJP."""
+def _lrn_fused_fwd_impl(x, local_size: int, alpha: float, beta: float,
+                        k: float, tile: int, interpret: Optional[bool]):
     if interpret is None:
         interpret = _interpret_default()
     n, c, h, w = x.shape
@@ -436,3 +435,41 @@ def lrn_fused(x, local_size: int, alpha: float, beta: float, k: float = 1.0,
         interpret=interpret,
     )(x2)
     return out.reshape(n, c, h, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def lrn_fused(x, local_size: int, alpha: float, beta: float, k: float = 1.0,
+              tile: int = 512, interpret: Optional[bool] = None):
+    """Fused LRN forward: x (N, C, H, W), one VMEM pass per spatial tile.
+    Backward recomputes through the differentiable XLA formulation
+    (ops/nn.lrn_across_channels) — O(1) residual, matching Caffe's LRN
+    semantics bit-for-bit on the gradient path."""
+    return _lrn_fused_fwd_impl(x, local_size, alpha, beta, k, tile,
+                               interpret)
+
+
+def _lrn_fused_vjp_fwd(x, local_size, alpha, beta, k, tile, interpret):
+    return _lrn_fused_fwd_impl(x, local_size, alpha, beta, k, tile,
+                               interpret), x
+
+
+def _lrn_fused_vjp_bwd(local_size, alpha, beta, k, tile, interpret, x, g):
+    from .nn import lrn_across_channels
+    _, vjp = jax.vjp(
+        lambda x_: lrn_across_channels(x_, local_size, alpha, beta, k), x)
+    return vjp(g)
+
+
+lrn_fused.defvjp(_lrn_fused_vjp_fwd, _lrn_fused_vjp_bwd)
+
+
+def maybe_lrn_fused(x, local_size: int, alpha: float, beta: float,
+                    k: float = 1.0):
+    """Route ACROSS_CHANNELS LRN through the fused Pallas kernel on real
+    TPU hardware (one HBM round-trip instead of the unfused chain); fall
+    back to the XLA formulation everywhere else (interpret-mode emulation
+    would only slow things down)."""
+    from .nn import lrn_across_channels
+    if not _interpret_default():
+        return lrn_fused(x, local_size, alpha, beta, k)
+    return lrn_across_channels(x, local_size, alpha, beta, k)

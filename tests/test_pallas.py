@@ -60,14 +60,20 @@ def test_flash_attention_grad_under_jit_and_vmapless_batch(qkv):
         assert np.isfinite(np.asarray(g)).all()
 
 
-def test_maybe_flash_falls_back_off_tpu(qkv):
-    """Off-TPU routing must use the dense op (interpret-mode Pallas would be
-    an emulation slowdown), bit-identical to attention()."""
+def test_maybe_flash_routing(qkv):
+    """Off-TPU, routing must use the dense op (interpret-mode Pallas would
+    be an emulation slowdown) — bit-identical to attention(). On a real TPU
+    (POSEIDON_TEST_TPU=1 runs), routing takes the Mosaic-compiled flash
+    kernel instead — numerically close, not bitwise."""
     from poseidon_tpu.ops.pallas_kernels import maybe_flash_attention
     q, k, v = qkv
     got = maybe_flash_attention(q, k, v, causal=True)
     want = attention(q, k, v, causal=True)
-    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    if jax.default_backend() == "tpu":
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+    else:
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_lrn_fused_matches_reference():
@@ -77,3 +83,31 @@ def test_lrn_fused_matches_reference():
     got = lrn_fused(x, 5, 1e-4, 0.75)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_fused_gradient_matches_reference():
+    """The recompute VJP: grad through the Pallas forward must equal grad
+    through the XLA formulation (it literally recomputes through it)."""
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(2, 16, 6, 6).astype(np.float32))
+    g_ref = jax.grad(
+        lambda x_: jnp.sum(lrn_across_channels(x_, 5, 1e-4, 0.75) ** 2))(x)
+    g_fused = jax.grad(
+        lambda x_: jnp.sum(lrn_fused(x_, 5, 1e-4, 0.75) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_maybe_lrn_fused_routing():
+    """Off-TPU the router must take the XLA path bit-for-bit; on TPU it
+    takes the Mosaic kernel (allclose)."""
+    from poseidon_tpu.ops.pallas_kernels import maybe_lrn_fused
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(1, 8, 5, 5).astype(np.float32))
+    got = maybe_lrn_fused(x, 5, 1e-4, 0.75)
+    want = lrn_across_channels(x, 5, 1e-4, 0.75)
+    if jax.default_backend() == "tpu":
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+    else:
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
