@@ -66,11 +66,32 @@ def main() -> int:
     results = []
 
     # 1 — the headline bench, with trace capture for the overlap analysis
-    results.append(_run(
+    bench_res = _run(
         "bench", [sys.executable, "bench.py"],
         env={"POSEIDON_BENCH_TRACE": trace_dir,
              "POSEIDON_BENCH_BUDGET_S": "1500"},
-        timeout=2400))
+        timeout=2400)
+    results.append(bench_res)
+
+    # 1b — DWBP escalation: if the A/B shows no overlap win, retry with
+    # XLA's latency-hiding scheduler + async collectives explicitly on
+    # (the knobs the round-2 verdict names) and record the delta
+    try:
+        line = json.loads([ln for ln in bench_res.get("stdout_tail", [])
+                           if ln.startswith("{")][-1])
+        overlap = float(line.get("dwbp_overlap_speedup", 0) or 0)
+    except Exception:  # noqa: BLE001
+        overlap = 0.0
+    if bench_res["rc"] == 0 and 0 < overlap < 1.02:
+        results.append(_run(
+            "bench_lhs_flags", [sys.executable, "bench.py"],
+            env={"POSEIDON_BENCH_BUDGET_S": "900",
+                 "POSEIDON_BENCH_GOOGLENET": "0", "POSEIDON_BENCH_LM": "0",
+                 "POSEIDON_BENCH_LAYOUT_AB": "0",
+                 "LIBTPU_INIT_ARGS":
+                     "--xla_tpu_enable_latency_hiding_scheduler=true "
+                     "--xla_enable_async_all_reduce=true"},
+            timeout=1500))
 
     # 2 — Mosaic-compile the Pallas kernels on hardware (the conftest pins
     # CPU unless POSEIDON_TEST_TPU=1; on the tpu backend interpret=False is
