@@ -117,3 +117,24 @@ def test_two_process_cli_test_command(tmp_path):
     assert "loss:" in logs[0]
     assert "accuracy:" in logs[0]
     assert "loss:" not in logs[1]
+
+
+@pytest.mark.skipif(not os.path.isdir(
+    os.path.join(REPO, "examples/mnist/mnist_train_lmdb")),
+    reason="synthetic MNIST LMDB not generated")
+def test_two_process_ssp_two_tier_wire(tmp_path):
+    """The full round-3 composition across TWO REAL PROCESSES: staleness on
+    the inter-process (DCN) tier, dense intra-process tier, bf16 wire,
+    blocked TOPK. Each process's 4 local devices form one slice; the slices
+    diverge for one step and reconcile compressed bf16 deltas over the
+    process boundary — the SSPAggr deployment on a real process topology."""
+    logs, snaps = _run_local_train(
+        tmp_path, "lenet_sspaggr", 10,
+        ["--staleness", "1", "--dcn_slices", "2", "--strategy", "topk",
+         "--wire_dtype", "bf16", "--topk_block", "256"])
+    assert "Iteration 10" in logs[0] or "Iteration 5" in logs[0]
+    # SSP state with per-slice groups: local replicas stacked (2, ...)
+    local_keys = [k for k in snaps[0].files if k.startswith("local_params/")]
+    assert local_keys, sorted(snaps[0].files)[:8]
+    for k in local_keys:
+        assert snaps[0][k].shape[0] == 2, (k, snaps[0][k].shape)
