@@ -36,6 +36,11 @@ def main() -> None:
                     help="per-device batch; 0 = 256 on TPU, 32 on CPU")
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--classes", type=int, default=1000,
+                    help="output classes; 21841 reproduces the reference's "
+                         "ImageNet-22K benchmark shape (fc8 = 89M params, "
+                         "docs/performance.md:56-73 — where SFB's "
+                         "O(B(M+N)) vs O(MN) trade is largest)")
     args = ap.parse_args()
 
     import jax
@@ -43,8 +48,9 @@ def main() -> None:
     from poseidon_tpu import config
     from poseidon_tpu.core.net import Net
     from poseidon_tpu.models import zoo
-    from poseidon_tpu.parallel import (CommConfig, SFB, build_train_step,
+    from poseidon_tpu.parallel import (CommConfig, build_train_step,
                                        init_train_state, make_mesh)
+    from poseidon_tpu.parallel.strategies import auto_strategies
     from poseidon_tpu.proto.messages import SolverParameter
 
     backend = jax.default_backend()
@@ -54,12 +60,15 @@ def main() -> None:
 
     n_dev = jax.device_count()
     mesh = make_mesh()
-    net_param = zoo.alexnet(num_classes=1000, with_accuracy=False)
+    net_param = zoo.alexnet(num_classes=args.classes, with_accuracy=False)
     shapes = {"data": (per_dev, 3, 227, 227), "label": (per_dev,)}
     net = Net(net_param, phase="TRAIN", source_shapes=shapes)
     sp = SolverParameter(base_lr=0.01, lr_policy="step", gamma=0.1,
                          stepsize=100000, momentum=0.9, weight_decay=5e-4)
-    comm = CommConfig(layer_strategies={"fc6": SFB, "fc7": SFB})
+    # SACP cost model picks SFB per FC layer (at 21841 classes fc8's 89M
+    # params make the O(B(M+N)) factor exchange the biggest win)
+    strategies = auto_strategies(net)
+    comm = CommConfig(layer_strategies=strategies)
     ts = build_train_step(net, sp, mesh, comm, donate=True)
     params = net.init(jax.random.PRNGKey(0))
     state = init_train_state(params, comm, n_dev)
@@ -68,7 +77,8 @@ def main() -> None:
         "data": jnp.asarray(
             rs.rand(per_dev * n_dev, 3, 227, 227).astype(np.float32),
             device=ts.batch_sharding),
-        "label": jnp.asarray(rs.randint(0, 1000, size=(per_dev * n_dev,)),
+        "label": jnp.asarray(rs.randint(0, args.classes,
+                                        size=(per_dev * n_dev,)),
                              device=ts.batch_sharding),
     }
 
@@ -102,8 +112,9 @@ def main() -> None:
         "n_devices": n_dev,
         "per_device_batch": per_dev,
         "image": 227,
-        "classes": 1000,
+        "classes": args.classes,
         "compile_s": round(compile_s, 1),
+        "sfb_layers": sorted(strategies),
         "images_per_sec": round(per_dev * n_dev / step_s, 1),
         "loss": float(m["loss"]),
         **peak,
