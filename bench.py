@@ -226,6 +226,14 @@ def main() -> None:
         extras.setdefault("skipped_over_budget", []).append(section)
         return False
 
+    # POSEIDON_BENCH_LAYOUT=NHWC takes the headline with the channels-last
+    # internal conv layout (use when the layout A/B showed it wins — the
+    # evidence capture escalates to this automatically)
+    layout = os.environ.get("POSEIDON_BENCH_LAYOUT", "")
+    if layout:
+        config.set_policy(conv_layout=layout)
+        extras["conv_layout"] = layout
+
     try:
         # ---- AlexNet (the headline number) --------------------------------
         from poseidon_tpu.parallel import SFB
@@ -267,7 +275,7 @@ def main() -> None:
 
         # ---- Conv layout A/B: NCHW vs internal NHWC -----------------------
         if os.environ.get("POSEIDON_BENCH_LAYOUT_AB", "1") == "1" and \
-                budget_left("layout_ab"):
+                not layout and budget_left("layout_ab"):
             with config.policy_scope(conv_layout="NHWC"):
                 ts3, p3, s3, b3 = _build(
                     "alexnet", per_dev_batch, image, classes,
