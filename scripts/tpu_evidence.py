@@ -130,6 +130,21 @@ def main() -> int:
         [sys.executable, "scripts/run_alexnet_realshape.py", "--steps", "3"],
         timeout=1800))
 
+    # 3b — per-layer fwd/bwd timing on hardware (the `caffe time` analog;
+    # needs the synthetic ILSVRC12-shaped DB for real input shapes)
+    if not os.path.isdir(os.path.join(
+            REPO, "examples/imagenet/ilsvrc12_train_lmdb")):
+        _run("make_imagenet_db",
+             [sys.executable, "examples/make_synthetic_db.py", "imagenet",
+              "--train", "64", "--test", "16"],
+             timeout=900)
+    results.append(_run(
+        "time_per_layer",
+        [sys.executable, "-m", "poseidon_tpu", "time",
+         "--model", "examples/imagenet/alexnet_train_val.prototxt",
+         "--iterations", "5", "--per_layer"],
+        timeout=1200))
+
     # 4 — overlap proof from the trace
     results.append(_run(
         "dwbp_overlap",
