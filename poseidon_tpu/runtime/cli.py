@@ -284,15 +284,19 @@ def cmd_time(args) -> int:
         slices = args.dcn_slices
         # purely static accounting — a {axis: size} shape dict models the
         # requested topology without needing that many physical devices
+        wire = getattr(args, "wire_dtype", "") or None
+        blockk = getattr(args, "topk_block", 0) or None
         if slices > 1:
             if n % slices:
                 raise SystemExit(f"--dcn_slices {slices} does not divide "
                                  f"--comm_devices {n}")
             mesh_shape = {"dcn": slices, "data": n // slices}
-            cc = CommConfig(dcn_axis="dcn", default_strategy=args.strategy)
+            cc = CommConfig(dcn_axis="dcn", default_strategy=args.strategy,
+                            wire_dtype=wire, topk_block=blockk)
         else:
             mesh_shape = {"data": n}
-            cc = CommConfig(default_strategy=args.strategy)
+            cc = CommConfig(default_strategy=args.strategy,
+                            wire_dtype=wire, topk_block=blockk)
         if args.sfb_auto:
             cc.layer_strategies.update(auto_strategies(net))
         table = layer_comm_table(net, cc, mesh_shape)
@@ -455,6 +459,10 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["dense", "sfb", "topk"])
     ti.add_argument("--sfb-auto", action="store_true",
                     help="pick SFB per FC layer by cost model")
+    ti.add_argument("--wire_dtype", default="",
+                    choices=["", "f32", "bf16", "f16"],
+                    help="bill the comm table at this wire width")
+    ti.add_argument("--topk_block", type=int, default=0)
     ti.set_defaults(fn=cmd_time)
 
     dq = sub.add_parser("device_query", help="show accelerator info")
