@@ -237,10 +237,15 @@ def _blocked_select(flat: jax.Array, scores: jax.Array, k: int,
     spirit of the reference server, which ranks cheap per-row importance
     scores instead of sorting every element (server_table.cpp:263-297). A
     batched ``lax.top_k`` over (n_blocks, block) rows is far cheaper on TPU
-    than one global top-k over tens of millions of elements."""
+    than one global top-k over tens of millions of elements.
+
+    The budget is honored from below: kb = k // n_blocks per block (total
+    sent <= k; the remainder stays in the error-feedback residual). Callers
+    must only take this path when k >= n_blocks — smaller budgets fall back
+    to the exact global top-k, which is cheap at tiny k."""
     n = flat.size
     nb = -(-n // block)
-    kb = max(1, -(-k // nb))  # per-block budget; total >= k
+    kb = max(1, k // nb)  # per-block budget; total <= k (caller ensures k>=nb)
     pad = nb * block - n
     # pad with -inf scores so padding never wins a slot
     fp = jnp.pad(flat, (0, pad)).reshape(nb, block)
@@ -270,8 +275,13 @@ def topk_compress(g: jax.Array, fraction: float, error: jax.Array,
     the quantization error folded into the residual (nothing lost)."""
     flat = (g + error).reshape(-1)
     k = max(1, int(flat.size * fraction))
+    # blocked selection only when every block gets a budget slot, so the
+    # bandwidth contract (<= k entries) holds; tiny-k cases use the exact
+    # global top-k, which is cheap at tiny k
+    use_block = bool(block) and flat.size > block and \
+        k >= -(-flat.size // block)
     if policy == "magnitude":
-        if block and flat.size > block:
+        if use_block:
             sent = _blocked_select(flat, jnp.abs(flat), k, block)
         else:
             _, idx = lax.top_k(jnp.abs(flat), k)
@@ -284,7 +294,7 @@ def topk_compress(g: jax.Array, fraction: float, error: jax.Array,
             raise ValueError("random policy needs the step counter")
         key = jax.random.fold_in(jax.random.PRNGKey(17 + salt), step)
         scores = jax.random.uniform(key, flat.shape)
-        if block and flat.size > block:
+        if use_block:
             sent = _blocked_select(flat, scores, k, block)
         else:
             _, idx = lax.top_k(scores, k)

@@ -187,7 +187,8 @@ def build_train_step(
                 err = state.comm_error[lname][pname][0]  # unstack group dim
                 if dcn:
                     # fast tier: dense sum inside the slice (cheap ICI, at
-                    # wire width; the cast error folds into the residual);
+                    # wire width — pre-psum rounding here is the same
+                    # unrecoverable trade as the dense tier's);
                     # slow tier: compressed exchange between slices
                     g = wire_psum(g, (axis,), "sum", comm.wire_dtype)
                 sent, resid = topk_compress(g, topk_fraction, err,

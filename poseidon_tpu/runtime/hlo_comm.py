@@ -12,7 +12,7 @@ SPMD program, where the data plane is fixed at compile time.
 Usage:
     compiled = ts.lowerable.lower(params, state, batch, rng).compile()
     colls = parse_collectives(compiled.as_text())
-    summary = measured_comm_summary(colls, mesh_shape={"data": 8})
+    summary = measured_comm_summary(colls)
     # -> totals comparable against comm_stats.comm_summary()
 """
 
@@ -47,15 +47,16 @@ class Collective:
     kind: str            # all-reduce | all-gather | ...
     dtype: str           # dtype of the (first) payload
     shape: tuple         # shape of the (first) payload
-    payload_bytes: int   # logical result payload (per participant, whole tuple)
+    payload_bytes: int   # logical FULL payload (see _payload in the parser)
     group_size: int      # participants per replica group (1 = trivial)
     n_groups: int
 
     def wire_bytes_per_device(self) -> float:
         """Bytes each participant moves, ring-algorithm convention (the same
-        convention comm_stats.py bills): all-reduce = 2(n-1)/n of payload,
-        all-gather/reduce-scatter = (n-1)/n of the full result, permute and
-        all-to-all = the shard itself."""
+        convention comm_stats.py bills). ``payload_bytes`` is normalized by
+        the parser to the FULL logical payload per kind: the reduced tensor
+        (all-reduce), the gathered result (all-gather), the full input
+        (reduce-scatter / all-to-all), the sent shard (permute)."""
         n = self.group_size
         if n <= 1:
             return 0.0
@@ -64,6 +65,29 @@ class Collective:
         if self.kind in ("all-gather", "reduce-scatter", "all-to-all"):
             return (n - 1) / n * self.payload_bytes
         return float(self.payload_bytes)  # collective-permute
+
+
+def _payload(kind: str, is_start: bool, tuple_bytes: float, n: int) -> float:
+    """Normalize a parsed LHS byte sum to the FULL logical payload.
+
+    Sync ops' LHS is the result alone (possibly a combined tuple of
+    results); async ``-start`` ops carry (operands..., results...) — the
+    operand buffers must not be double-counted. reduce-scatter's sync LHS
+    is the per-device SHARD, so the full input is shard x n."""
+    if n <= 1:
+        return tuple_bytes
+    if kind == "all-reduce":
+        # operand == result, so -start tuples hold each payload twice
+        return tuple_bytes / 2 if is_start else tuple_bytes
+    if kind == "all-gather":
+        # start tuple = operand (1/n of result) + result
+        return tuple_bytes * n / (n + 1) if is_start else tuple_bytes
+    if kind == "reduce-scatter":
+        # start tuple = full operand + shard result; sync LHS = shard only
+        return tuple_bytes * n / (n + 1) if is_start else tuple_bytes * n
+    # collective-permute-start: (in, out, [u32 contexts]); all-to-all-start:
+    # (in, out). in == out, contexts are scalar-sized noise.
+    return tuple_bytes / 2 if is_start else tuple_bytes
 
 
 def parse_collectives(hlo_text: str) -> List[Collective]:
@@ -80,8 +104,10 @@ def parse_collectives(hlo_text: str) -> List[Collective]:
         if m is None:
             continue
         kind = m.group(1)
-        # payload = every dtype[dims] between "= " and the op keyword
-        # (a single shape, or the elements of a combined tuple)
+        is_start = line[m.start():m.end()].rstrip("(").endswith("-start")
+        # sum every dtype[dims] between "= " and the op keyword (a single
+        # shape, or the elements of a combined/async tuple); _payload then
+        # normalizes to the full logical payload per kind
         lhs = line[line.index(" = ") + 3:m.start()]
         payload = 0
         first: Optional[tuple] = None
@@ -107,7 +133,9 @@ def parse_collectives(hlo_text: str) -> List[Collective]:
         else:
             group_size, n_groups = 1, 1
         out.append(Collective(kind=kind, dtype=first[0], shape=first[1],
-                              payload_bytes=payload, group_size=group_size,
+                              payload_bytes=int(_payload(
+                                  kind, is_start, payload, group_size)),
+                              group_size=group_size,
                               n_groups=n_groups))
     return out
 

@@ -44,7 +44,11 @@ def find_xplane(trace_dir: str) -> str:
 
 
 def load_device_events(path: str):
-    """-> list of (name, start_ps, dur_ps) from device-side xlines."""
+    """-> {plane_name: [(name, start_ps, dur_ps)]} from device-side xplanes.
+
+    Kept per plane: each device/core has its own timeline, and overlap must
+    be computed within one core — a collective on core 0 is NOT hidden by
+    compute running on core 1."""
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
     except ImportError:  # proto location moved across TF versions
@@ -68,13 +72,16 @@ def load_device_events(path: str):
         is_device = ("tpu" in pname or "device" in pname) and \
             "host" not in pname
         (device if is_device else rest).append(plane)
-    events = [e for p in device for e in plane_events(p)]
-    if not events:  # CPU smoke traces have only host planes
-        events = [e for p in rest for e in plane_events(p)]
-    return events
+    planes = {p.name: plane_events(p) for p in device}
+    planes = {k: v for k, v in planes.items() if v}
+    if not planes:  # CPU smoke traces have only host planes
+        planes = {p.name: plane_events(p) for p in rest}
+        planes = {k: v for k, v in planes.items() if v}
+    return planes
 
 
-def overlap_fraction(events) -> dict:
+def _plane_overlap(events):
+    """(collective_ps, overlapped_ps, n_colls, n_comp) for ONE timeline."""
     # drop python-frame ("$...") and paired end-marker host events
     events = [(n, s, d) for n, s, d in events
               if n and not n.startswith(("$", "end:"))]
@@ -106,13 +113,34 @@ def overlap_fraction(events) -> dict:
 
     total = sum(e - s for s, e, _ in colls)
     over = sum(covered(s, e) for s, e, _ in colls)
+    return total, over, len(colls), len(comp)
+
+
+def overlap_fraction(planes) -> dict:
+    """Aggregate per-plane (per-core) overlap: a collective only counts as
+    hidden when compute on ITS OWN timeline covers it. Accepts either a
+    {plane: events} dict or a bare event list (treated as one plane)."""
+    if not isinstance(planes, dict):
+        planes = {"<events>": planes}
+    total = over = 0.0
+    n_colls = n_comp = 0
+    per_plane = {}
+    for name, events in planes.items():
+        t, o, nc, np_ = _plane_overlap(events)
+        total += t
+        over += o
+        n_colls += nc
+        n_comp += np_
+        if nc:
+            per_plane[name] = round(o / t, 4)
     return {
         "metric": "dwbp_overlap_fraction",
         "value": round(over / total, 4) if total else None,
         "collective_ms": round(total / 1e9, 3),
         "overlapped_ms": round(over / 1e9, 3),
-        "n_collectives": len(colls),
-        "n_compute_events": len(comp),
+        "n_collectives": n_colls,
+        "n_compute_events": n_comp,
+        "per_plane": per_plane,
     }
 
 

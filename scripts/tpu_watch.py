@@ -64,8 +64,8 @@ def main() -> None:
                     capture_output=True, text=True,
                     timeout=3600 if not args.evidence else 9000, cwd=REPO)
             except subprocess.TimeoutExpired:
-                # tunnel flapped mid-bench; the watcher must outlive it
-                print(f"[{_now()}] bench hung past 3600s; will retry",
+                # tunnel flapped mid-run; the watcher must outlive it
+                print(f"[{_now()}] capture hung past its timeout; will retry",
                       flush=True)
                 time.sleep(args.interval)
                 continue

@@ -104,3 +104,38 @@ def test_two_tier_groups_parsed(lenet_net):
     sizes = {c.group_size for c in colls}
     # intra-slice (4-wide) dense psums AND inter-slice (2-wide) exchanges
     assert 4 in sizes and 2 in sizes, sizes
+
+
+def test_async_start_tuple_payload_normalization():
+    """-start ops carry (operands..., results...); the parser must not
+    double-count, and reduce-scatter must bill the FULL input either form."""
+    from poseidon_tpu.runtime.hlo_comm import parse_collectives
+    hlo = "\n".join([
+        # async all-reduce: operand + result (equal) -> payload = one copy
+        "%ar = (f32[100]{0}, f32[100]{0}) all-reduce-start(%x), "
+        "replica_groups={{0,1,2,3}}, to_apply=%add",
+        # sync all-reduce, combined tuple of two results -> payload = sum
+        "%arc = (f32[100]{0}, f32[50]{0}) all-reduce(%a, %b), "
+        "replica_groups={{0,1,2,3}}, to_apply=%add",
+        # async all-gather: operand (1/4) + full result -> payload = full
+        "%ag = (f32[25]{0}, f32[100]{0}) all-gather-start(%x), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}",
+        # sync reduce-scatter: LHS is the SHARD -> payload = shard x n
+        "%rs = f32[25]{0} reduce-scatter(%x), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}, to_apply=%add",
+        # async reduce-scatter: full operand + shard -> payload = full
+        "%rs2 = (f32[100]{0}, f32[25]{0}) reduce-scatter-start(%x), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}, to_apply=%add",
+    ])
+    colls = {c.kind + ("_sync" if i in (1, 3) else "_start"): c
+             for i, c in enumerate(parse_collectives(hlo))}
+    assert colls["all-reduce_start"].payload_bytes == 400
+    assert colls["all-reduce_sync"].payload_bytes == 600
+    assert colls["all-gather_start"].payload_bytes == 400
+    assert colls["reduce-scatter_sync"].payload_bytes == 400
+    assert colls["reduce-scatter_start"].payload_bytes == 400
+    # wire convention: ar = 2(n-1)/n, ag/rs = (n-1)/n of the full payload
+    assert colls["all-reduce_start"].wire_bytes_per_device() == \
+        pytest.approx(600.0)
+    assert colls["reduce-scatter_sync"].wire_bytes_per_device() == \
+        pytest.approx(300.0)

@@ -702,3 +702,22 @@ def test_ssp_resume_across_topologies(mesh, two_tier_mesh, lenet_net,
         losses.append(float(m["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] + 0.05  # keeps converging after resume
+
+
+def test_blocked_topk_honors_budget_from_below():
+    """The blocked path never exceeds the k budget; when k < n_blocks it
+    falls back to exact global selection (budget contract, SSPAggr's
+    bandwidth bound)."""
+    from poseidon_tpu.parallel.strategies import topk_compress
+    rng = np.random.RandomState(0)
+    g = jnp.asarray(rng.randn(10000).astype(np.float32))
+    err = jnp.zeros(10000, jnp.float32)
+    # k = 100, blocks of 100 -> 100 blocks, kb = 1 -> exactly 100 sent
+    sent, _ = topk_compress(g, 0.01, err, "magnitude", block=100)
+    assert (np.asarray(sent) != 0).sum() == 100
+    # k = 10 < 100 blocks -> global fallback, exactly 10 sent (not 100)
+    sent2, _ = topk_compress(g, 0.001, err, "magnitude", block=100)
+    assert (np.asarray(sent2) != 0).sum() == 10
+    # global fallback picks the true global top-10
+    top10 = np.argsort(-np.abs(np.asarray(g)))[:10]
+    assert set(np.flatnonzero(np.asarray(sent2))) == set(top10)
