@@ -145,6 +145,14 @@ def main() -> int:
          "--iterations", "5", "--per_layer"],
         timeout=1200))
 
+    # 3c — static comm table vs the TPU-compiled program (async collective
+    # forms exercised on real HLO)
+    results.append(_run(
+        "comm_validation",
+        [sys.executable, "scripts/validate_comm_stats.py",
+         "--model", "alexnet", "--batch", "32", "--image", "227"],
+        timeout=1200))
+
     # 4 — overlap proof from the trace
     results.append(_run(
         "dwbp_overlap",

@@ -1,0 +1,95 @@
+"""Static comm table vs the compiled program, on the current backend.
+
+Builds the AlexNet train step (fc6/fc7 SFB, the bench configuration),
+compiles it, parses every collective XLA emitted (runtime/hlo_comm.py), and
+reconciles per-device wire bytes against the static prediction
+(runtime/comm_stats.py). On TPU the compiled program may use async
+(-start/-done) collective forms and combined ops — the parser normalizes
+both. Prints ONE JSON line.
+
+Usage: python scripts/validate_comm_stats.py [--model alexnet]
+       [--batch 32] [--devices 0 (= all)]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="alexnet",
+                    choices=["alexnet", "lenet"])
+    ap.add_argument("--batch", type=int, default=8, help="per device")
+    ap.add_argument("--image", type=int, default=67)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import (CommConfig, SFB, build_train_step,
+                                       init_train_state, make_mesh)
+    from poseidon_tpu.proto.messages import SolverParameter
+    from poseidon_tpu.runtime.comm_stats import comm_summary, layer_comm_table
+    from poseidon_tpu.runtime.hlo_comm import (compare_static_vs_measured,
+                                               measured_comm_summary,
+                                               parse_collectives)
+
+    n_dev = jax.device_count()
+    mesh = make_mesh()
+    if args.model == "alexnet":
+        net_param = zoo.alexnet(num_classes=100, with_accuracy=False)
+        shapes = {"data": (args.batch, 3, args.image, args.image),
+                  "label": (args.batch,)}
+        comm = CommConfig(layer_strategies={"fc6": SFB, "fc7": SFB})
+    else:
+        net_param = zoo.lenet(with_accuracy=False)
+        shapes = zoo.lenet_shapes(args.batch)
+        comm = CommConfig()
+    net = Net(net_param, phase="TRAIN", source_shapes=shapes)
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    ts = build_train_step(net, sp, mesh, comm, donate=False)
+    params = net.init(jax.random.PRNGKey(0))
+    state = init_train_state(params, comm, n_dev)
+    rs = np.random.RandomState(0)
+    total = args.batch * n_dev
+    batch = {
+        "data": jnp.asarray(rs.rand(total, *shapes["data"][1:])
+                            .astype(np.float32)),
+        "label": jnp.asarray(rs.randint(
+            0, 100 if args.model == "alexnet" else 10, size=(total,))),
+    }
+    hlo = ts.lowerable.lower(params, state, batch,
+                             jax.random.PRNGKey(1)).compile().as_text()
+    colls = parse_collectives(hlo)
+    measured = measured_comm_summary(colls)
+    static = comm_summary(layer_comm_table(net, comm, mesh))
+    out = {
+        "metric": "comm_static_vs_measured",
+        "backend": jax.default_backend(),
+        "n_devices": n_dev,
+        "model": args.model,
+        **compare_static_vs_measured(static, measured),
+        "by_kind": measured["by_kind"],
+        "by_dtype": measured["by_dtype"],
+        "n_collectives": measured["n_collectives"],
+        "async_forms": ("-start" in hlo),
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
