@@ -721,3 +721,21 @@ def test_blocked_topk_honors_budget_from_below():
     # global fallback picks the true global top-10
     top10 = np.argsort(-np.abs(np.asarray(g)))[:10]
     assert set(np.flatnonzero(np.asarray(sent2))) == set(top10)
+
+
+def test_wire_dtype_f16_converges(mesh, lenet_net, rng_np):
+    """f16 wire (the reference's actual DenseRowFloat16 dtype): narrower
+    exponent than bf16, still converges at LeNet scale with mean reduce
+    (overflow at extreme device counts is the documented trade)."""
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    cc = CommConfig(wire_dtype="f16")
+    ts = build_train_step(lenet_net, sp, mesh, cc, donate=False)
+    p, s = params, init_train_state(params, cc, N_DEV)
+    losses = []
+    for i in range(8):
+        p, s, m = ts.step(p, s, batch, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.8 * losses[0], losses
