@@ -1,0 +1,158 @@
+"""Decompose TPU-via-tunnel performance: compute vs dispatch vs transfer.
+
+The first-ever hardware bench (round 3) measured AlexNet at 757 ms/step
+(MFU 0.66%) while flash-attention timings came back flat at ~0.02 ms for
+any sequence length — mutually inconsistent unless something other than
+device compute dominates (or timing is broken). This battery isolates:
+
+  1. matmul_scan      — N matmuls chained inside one jitted lax.scan:
+                        ONE dispatch, pure device compute -> real MXU
+                        TFLOP/s achievable through this backend.
+  2. matmul_dispatch  — the same matmul dispatched N times from the host
+                        (async queue, one final block): per-step dispatch
+                        pipeline throughput.
+  3. dispatch_latency — tiny op, dispatch+block each iteration: the
+                        round-trip latency floor per synchronous step.
+  4. h2d / d2h        — device_put / np.asarray of a 128 MB buffer.
+  5. donate_cycle     — a donated 128 MB buffer through a trivial jitted
+                        update, per-dispatch: does donation round-trip
+                        the tunnel?
+
+Each section prints one JSON line; the summary says which regime the
+AlexNet step time lives in.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+    emit(section="env", platform=dev.platform, device_kind=dev.device_kind)
+
+    # ---- 1. pure device compute: one dispatch, N matmuls inside scan ----
+    n, k = 4096, 64  # k matmuls of (n,n)@(n,n) bf16
+    a = jnp.asarray(np.random.rand(n, n), dtype=jnp.bfloat16)
+
+    @jax.jit
+    def chained(a):
+        def body(x, _):
+            return (x @ a).astype(jnp.bfloat16), None
+        y, _ = lax.scan(body, a, None, length=k)
+        return y
+
+    y = chained(a)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    y = chained(a)
+    jax.block_until_ready(y)
+    dt = time.perf_counter() - t0
+    flops = 2.0 * n * n * n * k
+    emit(section="matmul_scan", n=n, chain=k, seconds=round(dt, 4),
+         tflops=round(flops / dt / 1e12, 2))
+
+    # ---- 2. same work, one dispatch per matmul (async, block at end) ----
+    @jax.jit
+    def one(x):
+        return (x @ a).astype(jnp.bfloat16)
+
+    x = one(a)
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    x = a
+    for _ in range(k):
+        x = one(x)
+    jax.block_until_ready(x)
+    dt2 = time.perf_counter() - t0
+    emit(section="matmul_dispatch", n=n, iters=k, seconds=round(dt2, 4),
+         tflops=round(flops / dt2 / 1e12, 2),
+         per_dispatch_ms=round(dt2 / k * 1e3, 3))
+
+    # ---- 3. dispatch+block round-trip latency floor ----
+    s = jnp.zeros((8, 128), jnp.float32)
+
+    @jax.jit
+    def bump(v):
+        return v + 1.0
+
+    v = bump(s)
+    jax.block_until_ready(v)
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        v = bump(v)
+        jax.block_until_ready(v)
+    dt3 = time.perf_counter() - t0
+    emit(section="dispatch_latency", iters=iters,
+         ms_per_roundtrip=round(dt3 / iters * 1e3, 3))
+
+    # ---- 4. transfers ----
+    mb = 128
+    host = np.random.rand(mb * 1024 * 1024 // 4).astype(np.float32)
+    t0 = time.perf_counter()
+    d = jax.device_put(host)
+    jax.block_until_ready(d)
+    h2d = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    back = np.asarray(d)
+    d2h = time.perf_counter() - t0
+    emit(section="transfer", mb=mb, h2d_s=round(h2d, 3),
+         h2d_mb_s=round(mb / h2d, 1), d2h_s=round(d2h, 3),
+         d2h_mb_s=round(mb / d2h, 1), checksum=float(back[0]))
+
+    # ---- 5. donated big-buffer update, per-dispatch ----
+    big = jax.device_put(host)
+
+    @jax.jit
+    def upd(p):
+        return p * 0.999
+
+    big = upd(big)  # not donated on first call? warm anyway
+    jax.block_until_ready(big)
+    upd2 = jax.jit(lambda p: p * 0.999, donate_argnums=0)
+    big = upd2(big)
+    jax.block_until_ready(big)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        big = upd2(big)
+    jax.block_until_ready(big)
+    dt5 = time.perf_counter() - t0
+    emit(section="donate_cycle", mb=mb, iters=iters,
+         ms_per_step=round(dt5 / iters * 1e3, 3))
+
+    # ---- 6. the AlexNet-step-shaped probe: scan K steps on device ----
+    # If one dispatch of K chained "steps" runs K
+    # times faster per step than K dispatches, dispatch dominates.
+    @jax.jit
+    def multi(a):
+        def body(x, _):
+            return (x @ a).astype(jnp.bfloat16), None
+        y, _ = lax.scan(body, a, None, length=8)
+        return y
+
+    y = multi(a)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        y = multi(y)
+    jax.block_until_ready(y)
+    dt6 = time.perf_counter() - t0
+    emit(section="scan8_x8_dispatch", seconds=round(dt6, 4),
+         per_dispatch_ms=round(dt6 / 8 * 1e3, 3))
+
+
+if __name__ == "__main__":
+    main()
