@@ -6,7 +6,7 @@ from .strategies import (  # noqa: F401
 from .trainer import (  # noqa: F401
     SSPState, TrainState, build_eval_step, build_ssp_train_step,
     build_train_step, comm_error_groups, init_comm_error, init_ssp_state,
-    init_train_state, param_mults, reconcile_comm_error,
+    init_train_state, param_mults, reconcile_comm_error, stack_batches,
 )
 from .sequence import (  # noqa: F401
     ring_attention, ring_flash_attention, ulysses_attention,

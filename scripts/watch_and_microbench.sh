@@ -1,0 +1,15 @@
+#!/bin/bash
+# Poll the backend; the moment it answers, run the microbench battery.
+# JSON lines land in evidence/microbench_tpu.jsonl (append, stdout only);
+# diagnostics/tracebacks go to evidence/microbench_tpu.err.
+cd /root/repo
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[$(date +%H:%M:%S)] tunnel UP - microbenching"
+    timeout 900 python scripts/tpu_microbench.py \
+      2>>evidence/microbench_tpu.err | tee -a evidence/microbench_tpu.jsonl
+    exit 0
+  fi
+  echo "[$(date +%H:%M:%S)] tunnel down"
+  sleep 150
+done
