@@ -63,7 +63,8 @@ def _engine_from_args(args, phase_nets=True):
         comm.dcn_axis = "dcn"
     staleness = getattr(args, "staleness", 0)
     return Engine(sp, comm=comm, mesh=mesh, output_dir=args.output_dir,
-                  staleness=staleness, sfb_auto=args.sfb_auto)
+                  staleness=staleness, sfb_auto=args.sfb_auto,
+                  steps_per_dispatch=getattr(args, "steps_per_dispatch", 1))
 
 
 def cmd_train(args) -> int:
@@ -431,6 +432,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cluster hostfile ('<id> <ip> <port>' lines)")
     t.add_argument("--node_id", type=int, default=-1,
                    help="this process's hostfile id")
+    t.add_argument("--steps_per_dispatch", type=int, default=1,
+                   help="run K optimizer steps per compiled dispatch "
+                        "(lax.scan): amortizes per-dispatch runtime "
+                        "round-trip; falls back to single steps near "
+                        "display/test/snapshot boundaries")
     t.add_argument("--profile", type=int, default=0,
                    help="capture an xplane trace over N steps (from step 10)")
     t.set_defaults(fn=cmd_train)

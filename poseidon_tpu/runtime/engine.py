@@ -36,7 +36,8 @@ from ..core.layers import DATA_SOURCE_TYPES
 from ..parallel import (CommConfig, build_eval_step, build_ssp_train_step,
                         build_train_step, init_ssp_state, init_train_state,
                         make_mesh)
-from ..parallel.trainer import SSPState, TrainStep, comm_error_groups
+from ..parallel.trainer import (SSPState, TrainStep, comm_error_groups,
+                                stack_batches)
 from ..proto.messages import (NetParameter, SolverParameter, load_net,
                               load_solver)
 from ..solvers.updates import learning_rate
@@ -79,6 +80,7 @@ class Engine:
         output_dir: str = ".",
         staleness: int = 0,
         sfb_auto: bool = False,
+        steps_per_dispatch: int = 1,
     ):
         self.sp = sp
         self.mesh = mesh or make_mesh()
@@ -161,6 +163,29 @@ class Engine:
             dump = sorted({b for _, bs in self._h5_train for b in bs})
             self.train_step = build_train_step(self.train_net, sp, self.mesh,
                                                self.comm, dump_blobs=dump)
+
+        # --- multi-step dispatch (scan chunks) ---------------------------- #
+        # K optimizer steps per compiled dispatch: amortizes the runtime's
+        # per-dispatch round-trip (dominant on tunneled/multi-host runtimes).
+        # The engine falls back to single steps near display/test/snapshot
+        # boundaries so solver cadence semantics are exact.
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        self._scan_step = None
+        if self.steps_per_dispatch > 1:
+            if staleness > 0:
+                log("WARNING: steps_per_dispatch ignored under SSP "
+                    "staleness (the SSP step already batches local steps)",
+                    rank=self.rank)
+                self.steps_per_dispatch = 1
+            elif self._h5_train:
+                log("WARNING: steps_per_dispatch ignored with HDF5_OUTPUT "
+                    "in the TRAIN net (per-iteration dump semantics)",
+                    rank=self.rank)
+                self.steps_per_dispatch = 1
+            else:
+                self._scan_step = build_train_step(
+                    self.train_net, sp, self.mesh, self.comm,
+                    scan_steps=self.steps_per_dispatch)
         self.eval_steps = [
             build_eval_step(n, self.mesh, dcn_axis=self.comm.dcn_axis)
             for n in self.test_nets]
@@ -245,6 +270,20 @@ class Engine:
                     batch[k] = jax.device_put(v, sharding)
         return batch
 
+    def _next_batch_stack(self, pipes: List[BatchPipeline], k: int):
+        """k host batches stacked to [k, ...] and placed in ONE transfer
+        (the feeding side of steps_per_dispatch)."""
+        rows: List[Dict[str, np.ndarray]] = [{} for _ in range(k)]
+        for pipe in pipes:
+            for i in range(k):
+                rows[i].update(next(pipe))
+        sharding = self._scan_step.batch_sharding
+        if jax.process_count() > 1:
+            return {key: jax.make_array_from_process_local_data(
+                        sharding, np.stack([r[key] for r in rows]))
+                    for key in rows[0]}
+        return stack_batches(rows, sharding)
+
     # ---------------------------------------------------------------- #
     def iteration(self) -> int:
         return int(self.state.it if self.staleness > 0
@@ -315,6 +354,32 @@ class Engine:
         self.test_metrics[test_id].accumulate(out)
         return out
 
+    @staticmethod
+    def _metric_rows(pending: List[Dict]) -> List[Dict[str, float]]:
+        """Materialize buffered device metrics into one float row per
+        optimizer step. Single-step entries hold scalars; scan-chunk
+        entries hold [K]-stacked arrays and expand to K rows."""
+        rows: List[Dict[str, float]] = []
+        for pm in pending:
+            arrs = {k: np.asarray(v) for k, v in pm.items()}
+            k_steps = max((a.shape[0] for a in arrs.values()
+                           if a.ndim >= 1), default=1)
+            if k_steps == 1 and all(a.ndim == 0 for a in arrs.values()):
+                rows.append({k: float(a) for k, a in arrs.items()})
+            else:
+                for i in range(k_steps):
+                    rows.append({k: float(a[i]) if a.ndim >= 1 else float(a)
+                                 for k, a in arrs.items()})
+        return rows
+
+    def _flush_pending(self, pending: List[Dict]) -> Dict[str, float]:
+        """Materialize buffered device metrics into the metrics table;
+        returns the last step's row."""
+        rows = self._metric_rows(pending)
+        for row in rows:
+            self.metrics.accumulate(row)
+        return rows[-1]
+
     def train(self, max_iter: Optional[int] = None) -> Dict[str, float]:
         sp = self.sp
         max_iter = max_iter or sp.max_iter
@@ -338,27 +403,62 @@ class Engine:
                 jax.profiler.start_trace(
                     os.path.join(self.output_dir, "profile"))
                 profiling = True
-            batch = self._next_batch(self.train_pipelines)
-            at_display = bool(sp.display) and (it + 1) % sp.display == 0
-            if at_display and self._debug_fn:
-                # BEFORE the step, on the step's own inputs (pre-update
-                # params, this iteration's rng/batch) — the values Caffe's
-                # ForwardDebugInfo/UpdateDebugInfo report for iteration it+1
-                stats = self._debug_fn(self.params, batch,
-                                       jax.random.fold_in(self.rng, it))
-                for key in sorted(stats):
-                    kind, name = key.split("\x00")
-                    log(f"    [debug] {kind:<5} {name}: "
-                        f"{float(stats[key]):.6g}", rank=self.rank)
-            t0 = time.time()
-            result = self.train_step.step(
-                self.params, self.state, batch, jax.random.fold_in(self.rng, it))
-            if self._h5_train:
-                self.params, self.state, m, dumps = result
-                self._write_train_h5(dumps)
+
+            # how many steps may run before the next host-side boundary
+            # (display flush / debug pre-step / test / snapshot / profile);
+            # a full steps_per_dispatch chunk runs as ONE compiled dispatch
+            chunk = 1
+            if self._scan_step is not None:
+                room = max_iter - it
+                if sp.display:
+                    d = sp.display - (it % sp.display)
+                    room = min(room, d - 1 if self._debug_fn else d)
+                if sp.test_interval and self.test_nets:
+                    room = min(room, sp.test_interval -
+                               (it % sp.test_interval))
+                if sp.snapshot:
+                    room = min(room, sp.snapshot - (it % sp.snapshot))
+                if self.profile_steps and \
+                        it < profile_start + self.profile_steps:
+                    # single-step dispatches only until the trace window
+                    # closes; afterwards chunking resumes
+                    room = min(room, profile_start - it) \
+                        if it < profile_start else 1
+                if room >= self.steps_per_dispatch:
+                    chunk = self.steps_per_dispatch
+
+            if chunk > 1:
+                batch = self._next_batch_stack(self.train_pipelines, chunk)
+                t0 = time.time()
+                self.params, self.state, m = self._scan_step.step(
+                    self.params, self.state, batch,
+                    jax.random.fold_in(self.rng, it))
+                it += chunk
+                at_display = bool(sp.display) and it % sp.display == 0
             else:
-                self.params, self.state, m = result
-            it += 1
+                batch = self._next_batch(self.train_pipelines)
+                at_display = bool(sp.display) and (it + 1) % sp.display == 0
+                if at_display and self._debug_fn:
+                    # BEFORE the step, on the step's own inputs (pre-update
+                    # params, this iteration's rng/batch) — the values
+                    # Caffe's ForwardDebugInfo/UpdateDebugInfo report for
+                    # iteration it+1
+                    stats = self._debug_fn(self.params, batch,
+                                           jax.random.fold_in(self.rng, it))
+                    for key in sorted(stats):
+                        kind, name = key.split("\x00")
+                        log(f"    [debug] {kind:<5} {name}: "
+                            f"{float(stats[key]):.6g}", rank=self.rank)
+                t0 = time.time()
+                result = self.train_step.step(
+                    self.params, self.state, batch,
+                    jax.random.fold_in(self.rng, it))
+                if self._h5_train:
+                    self.params, self.state, m, dumps = result
+                    self._write_train_h5(dumps)
+                else:
+                    self.params, self.state, m = result
+                it += 1
             if profiling and it >= profile_start + self.profile_steps:
                 jax.block_until_ready(m["loss"])
                 jax.profiler.stop_trace()
@@ -370,22 +470,16 @@ class Engine:
             # host on every step and serialize the async dispatch pipeline;
             # values materialize only at display boundaries
             pending.append(m)
-            self.stats.add("train_iters")
+            self.stats.add("train_iters", chunk)
             self.stats.add_time("train_step", time.time() - t0)
 
             if not sp.display and len(pending) >= 64:
                 # no display cadence configured: flush periodically so the
                 # window never pins unbounded live device buffers
-                for pm in pending:
-                    self.metrics.accumulate(
-                        {k: float(v) for k, v in pm.items()})
-                last = {k: float(v) for k, v in pending[-1].items()}
+                last = self._flush_pending(pending)
                 pending = []
             if at_display:  # same boundary: it has incremented since
-                for pm in pending:
-                    self.metrics.accumulate(
-                        {k: float(v) for k, v in pm.items()})
-                last = {k: float(v) for k, v in pending[-1].items()}
+                last = self._flush_pending(pending)
                 pending = []
                 row = self.metrics.flush_row(it)
                 lr = float(learning_rate(sp, jnp.asarray(it - 1)))
@@ -400,9 +494,7 @@ class Engine:
                     self.test_metrics[i].flush_row(it)
 
         if pending:  # tail iterations past the last display boundary
-            for pm in pending:
-                self.metrics.accumulate({k: float(v) for k, v in pm.items()})
-            last = {k: float(v) for k, v in pending[-1].items()}
+            last = self._flush_pending(pending)
         if profiling:
             jax.profiler.stop_trace()
             log(f"Wrote profiler trace to "

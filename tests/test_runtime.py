@@ -395,3 +395,46 @@ max_iter: 3
     # one (latest) global batch, not an accumulation across iterations
     assert feats.shape == (4 * N_DEV, 3)
     assert labels.shape == (4 * N_DEV,)
+
+
+def test_engine_steps_per_dispatch(tmp_path):
+    """Chunked dispatch (K steps per compiled program) trains like the
+    single-step engine and keeps exact display/test cadence: same number
+    of metric rows, convergence, boundary alignment (max_iter=30 with
+    display=10, test_interval=15, K=4 forces chunk fallbacks at 8->10,
+    12->15, 28->30)."""
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.engine import Engine
+
+    solver_path = _write_mnistish_prototxt(tmp_path)
+    sp = load_solver(solver_path)
+    eng = Engine(sp, memory_data=_memory_data(), output_dir=str(tmp_path),
+                 steps_per_dispatch=4)
+    try:
+        assert eng._scan_step is not None
+        last = eng.train()
+        assert last["loss"] < 0.3, f"did not converge: {last}"
+        out = eng.test(0)
+        assert out["accuracy"] > 0.9
+        # every optimizer step must have produced a metrics row
+        csv = (tmp_path / "SmallNet_train_outputs.csv").read_text()
+        data_rows = [ln for ln in csv.strip().splitlines()[1:] if ln]
+        # rows flush per display window (3 windows of 10 at max_iter 30)
+        assert len(data_rows) == 3, csv
+        assert eng.iteration() == sp.max_iter
+    finally:
+        eng.close()
+
+
+def test_engine_steps_per_dispatch_ssp_falls_back(tmp_path):
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.engine import Engine
+
+    solver_path = _write_mnistish_prototxt(tmp_path, max_iter=6)
+    sp = load_solver(solver_path)
+    eng = Engine(sp, memory_data=_memory_data(), output_dir=str(tmp_path),
+                 staleness=1, steps_per_dispatch=4)
+    try:
+        assert eng._scan_step is None and eng.steps_per_dispatch == 1
+    finally:
+        eng.close()
