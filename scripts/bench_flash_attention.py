@@ -6,6 +6,17 @@ flash-vs-XLA table (forward and forward+backward), including the regime
 where the dense op's (S, S) score matrix stops fitting HBM and flash keeps
 going — the long-context capability the kernels exist for.
 
+Timing methodology (hardened after the first TPU capture produced
+physically impossible 0.02 ms readings): each measurement runs K attention
+iterations INSIDE one jitted ``lax.scan`` whose carry feeds the previous
+output back into the next query (``q + 1e-3 * out``), so XLA cannot elide
+or deduplicate iterations, then fetches one device scalar to host —
+a device->host copy cannot be faked by an async runtime the way
+``block_until_ready`` on an experimental platform can. The per-iteration
+device time is the K-vs-2K wall-clock difference divided by K, which
+cancels dispatch/transfer round-trips exactly (the same differencing
+bench.py uses for the training step).
+
 Prints one JSON line per (S, impl, pass) plus a final summary line.
 CPU smoke: POSEIDON_FLASH_CPU=1 runs tiny shapes in interpret mode (wiring
 check only; the timings are meaningless off-TPU).
@@ -31,6 +42,7 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from poseidon_tpu.ops.attention import attention
     from poseidon_tpu.ops.pallas_kernels import flash_attention, pick_block
 
@@ -44,8 +56,37 @@ def main() -> None:
     seqs = [256] if cpu else [1024, 4096, 16384]
     B, H, D = 1, 8, 128
     dtype = jnp.float32 if cpu else jnp.bfloat16
-    iters = 2 if cpu else 10
+    k_iters = 2 if cpu else int(os.environ.get("POSEIDON_FLASH_SCAN", "8"))
     rows = []
+
+    def scan_runner(body, n):
+        """jit(q, k, v) -> final q after n chained body() iterations."""
+        @jax.jit
+        def run(q, k, v):
+            def step(carry_q, _):
+                out = body(carry_q, k, v)
+                return (carry_q + 1e-3 * out).astype(carry_q.dtype), ()
+            q_fin, _ = lax.scan(step, q, None, length=n)
+            return jnp.sum(q_fin[0, 0, 0, :8].astype(jnp.float32))
+        return run
+
+    def measure(body, q, k, v):
+        """Per-iteration device ms via K-vs-2K scan differencing; the fetch
+        of the returned scalar is the (unfakeable) synchronization point."""
+        run_a = scan_runner(body, k_iters)
+        run_b = scan_runner(body, 2 * k_iters)
+        reps = 1 if cpu else 3
+        walls = []
+        for run in (run_a, run_b):
+            float(run(q, k, v))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                float(run(q, k, v))  # host fetch forces completion
+            walls.append((time.perf_counter() - t0) / reps)
+        dev = (walls[1] - walls[0]) / k_iters
+        if dev <= 0:  # noise swamped the difference; report wall/K upper bound
+            return walls[0] / k_iters * 1e3, False
+        return dev * 1e3, True
 
     for S in seqs:
         rs = np.random.RandomState(0)
@@ -53,31 +94,34 @@ def main() -> None:
                    for _ in range(3))
         blk = pick_block(S) or 32
 
-        def time_fn(fn, *args):
-            out = fn(*args)
-            jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(*args)
-            jax.block_until_ready(out)
-            return (time.perf_counter() - t0) / iters * 1e3
+        fwd_bodies = {
+            "flash": lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, True, None, blk, blk, None if not cpu else True),
+            "dense": lambda q_, k_, v_: attention(q_, k_, v_, causal=True),
+        }
+        def make_grad_body(f):
+            # grad wrt all three inputs, summed into the carry — grad wrt q
+            # alone would let XLA DCE the dk/dv half of the backward
+            def body(q_, k_, v_):
+                dq, dk, dv = jax.grad(
+                    lambda qq, kk, vv: jnp.sum(f(qq, kk, vv) ** 2),
+                    argnums=(0, 1, 2))(q_, k_, v_)
+                return dq + dk + dv
+            return body
 
-        impls = {
-            "flash": jax.jit(lambda q_, k_, v_: flash_attention(
-                q_, k_, v_, True, None, blk, blk, None if not cpu else True)),
-            "dense": jax.jit(lambda q_, k_, v_: attention(
-                q_, k_, v_, causal=True)),
-        }
-        grads = {
-            name: jax.jit(jax.grad(
-                lambda q_, k_, v_, f=fn: jnp.sum(f(q_, k_, v_) ** 2)))
-            for name, fn in impls.items()
-        }
-        for name in impls:
+        grad_bodies = {name: make_grad_body(fn)
+                       for name, fn in fwd_bodies.items()}
+        for name in fwd_bodies:
             row = {"seq": S, "impl": name}
             try:
-                row["fwd_ms"] = round(time_fn(impls[name], q, k, v), 3)
-                row["fwd_bwd_ms"] = round(time_fn(grads[name], q, k, v), 3)
+                ms, ok = measure(fwd_bodies[name], q, k, v)
+                row["fwd_ms"] = round(ms, 3)
+                if not ok:
+                    row["fwd_differencing_failed"] = True
+                ms, ok = measure(grad_bodies[name], q, k, v)
+                row["fwd_bwd_ms"] = round(ms, 3)
+                if not ok:
+                    row["fwd_bwd_differencing_failed"] = True
             except Exception as e:  # noqa: BLE001 — dense OOMs at long S
                 row["error"] = f"{type(e).__name__}: {str(e)[:160]}"
             rows.append(row)
@@ -87,7 +131,7 @@ def main() -> None:
     for r in rows:
         by_seq.setdefault(r["seq"], {})[r["impl"]] = r
     summary = {"metric": "flash_vs_xla_attention", "backend": backend,
-               "table": []}
+               "scan_iters": k_iters, "table": []}
     for S, d in sorted(by_seq.items()):
         f, x = d.get("flash", {}), d.get("dense", {})
         entry = {"seq": S,
@@ -95,9 +139,13 @@ def main() -> None:
                  "dense_fwd_ms": x.get("fwd_ms"),
                  "flash_fwd_bwd_ms": f.get("fwd_bwd_ms"),
                  "dense_fwd_bwd_ms": x.get("fwd_bwd_ms")}
-        if f.get("fwd_bwd_ms") and x.get("fwd_bwd_ms"):
+        clean = not (f.get("fwd_bwd_differencing_failed") or
+                     x.get("fwd_bwd_differencing_failed"))
+        if f.get("fwd_bwd_ms") and x.get("fwd_bwd_ms") and clean:
             entry["flash_speedup_fwd_bwd"] = round(
                 x["fwd_bwd_ms"] / f["fwd_bwd_ms"], 2)
+        elif not clean:
+            entry["speedup_suppressed_differencing_failed"] = True
         if x.get("error"):
             entry["dense_error"] = x["error"]
         summary["table"].append(entry)
