@@ -298,6 +298,10 @@ def main() -> None:
         if not differencing_ok:  # noise swamped the difference; fall back
             dev = step_a         # wall-based: still contains overhead/K
         overhead = max(disp_a - scan * dev, 0.0)
+        # raw dispatch walls so a failed differencing is diagnosable from
+        # the JSON alone (is 2K genuinely not slower, or just noisy?)
+        extras.setdefault("dispatch_walls_ms", {})[model] = {
+            "k": round(disp_a * 1e3, 1), "2k": round(disp_b * 1e3, 1)}
         if not (fl_a and fl_b):
             per_step_flops, convention = fl_a, "unknown"
         elif fl_b / fl_a > 1.5:

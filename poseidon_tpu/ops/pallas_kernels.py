@@ -27,6 +27,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..config import matmul_precision
 from .attention import NEG_INF
 
 
@@ -93,7 +94,8 @@ def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         q = q_ref[0].astype(jnp.float32)         # (block_q, d)
         k_blk = k_ref[0].astype(jnp.float32)     # (block_k, d)
         v_blk = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32,
+            precision=matmul_precision()) * scale
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, mode)
         m_prev = m_ref[:, 0]
@@ -102,7 +104,8 @@ def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         p = jnp.exp(s - m_new[:, None])
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
+            p, v_blk, preferred_element_type=jnp.float32,
+            precision=matmul_precision())
         m_ref[:, 0] = m_new
 
     @pl.when(kj == n_kb - 1)
@@ -110,7 +113,11 @@ def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         l = l_ref[:, 0]
         lsafe = jnp.where(l == 0, 1.0, l)
         o_ref[0] = (acc_ref[:] / lsafe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(lsafe))[None]
+        # lse layout is (bh, s, 1): a (block_q, 1) tile keeps the minor dim
+        # equal to the full array dim, which Mosaic's tiling rules require
+        # for block_q < 128 (the (1, 1, block_q) layout only lowered with
+        # full-length 128 tiles)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(lsafe))[:, None]
 
 
 def _check_blocks(s, block_q, block_k):
@@ -152,13 +159,13 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
                           block_q=block_q, block_k=block_k, n_kb=n_kb,
                           chunk_mode=chunk),
         out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, 1, s), jnp.float32)),
+                   jax.ShapeDtypeStruct((bh, s, 1), jnp.float32)),
         grid=grid,
         in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
@@ -171,6 +178,11 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
         interpret=interpret,
     )(*args)
     return out.reshape(b, h, s, d), lse.reshape(b, h, s)
+
+
+def _row_ref(ref):
+    """(block_q,) row statistics from a (1, block_q, 1) lse/delta tile."""
+    return ref[0, :, 0]
 
 
 # --------------------------------------------------------------------------- #
@@ -205,16 +217,19 @@ def _flash_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         g = g_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, 0]                       # (block_q,)
-        delta = delta_ref[0, 0]                   # (block_q,)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        lse = _row_ref(lse_ref)                   # (block_q,)
+        delta = _row_ref(delta_ref)               # (block_q,)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32,
+            precision=matmul_precision()) * scale
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, mode)
         p = jnp.exp(s - lse[:, None])             # masked entries -> 0
-        dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32)
+        dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32,
+            precision=matmul_precision())
         ds = p * (dp - delta[:, None]) * scale
         dq_acc[:] = dq_acc[:] + jnp.dot(
-            ds, k_blk, preferred_element_type=jnp.float32)
+            ds, k_blk, preferred_element_type=jnp.float32,
+            precision=matmul_precision())
 
     @pl.when(kj == n_kb - 1)
     def _finalize():
@@ -250,18 +265,22 @@ def _flash_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         g = g_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        lse = _row_ref(lse_ref)
+        delta = _row_ref(delta_ref)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32,
+            precision=matmul_precision()) * scale
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, mode)
         p = jnp.exp(s - lse[:, None])             # (block_q, block_k)
         dv_acc[:] = dv_acc[:] + jnp.dot(
-            p.T, g, preferred_element_type=jnp.float32)
-        dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32)
+            p.T, g, preferred_element_type=jnp.float32,
+            precision=matmul_precision())
+        dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32,
+            precision=matmul_precision())
         ds = p * (dp - delta[:, None]) * scale
         dk_acc[:] = dk_acc[:] + jnp.dot(
-            ds.T, q, preferred_element_type=jnp.float32)
+            ds.T, q, preferred_element_type=jnp.float32,
+            precision=matmul_precision())
 
     @pl.when(qi == n_qb - 1)
     def _finalize():
@@ -284,8 +303,8 @@ def _flash_bwd(q, k, v, out, lse, g, scale: float, causal: bool,
                         axis=-1)                   # (b, h, s)
     r3 = lambda x: x.reshape(bh, s, x.shape[-1])
     q3, k3, v3, g3 = r3(q), r3(k), r3(v), r3(g)
-    lse3 = lse.reshape(bh, 1, s)
-    delta3 = delta.reshape(bh, 1, s)
+    lse3 = lse.reshape(bh, s, 1)
+    delta3 = delta.reshape(bh, s, 1)
     chunk = mode is not None
     mode_arg = [jnp.asarray(mode, jnp.int32).reshape(1)] if chunk else []
     smem = [pl.BlockSpec(memory_space=pltpu.SMEM)] if chunk else []
@@ -294,7 +313,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale: float, causal: bool,
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0),
                          memory_space=pltpu.VMEM)
-    rowq = pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j),
+    rowq = pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0),
                         memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -316,7 +335,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale: float, causal: bool,
                            memory_space=pltpu.VMEM)
     kspec_t = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0),
                            memory_space=pltpu.VMEM)
-    rowq_t = pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, kk),
+    rowq_t = pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, kk, 0),
                           memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,

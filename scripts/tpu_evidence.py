@@ -17,10 +17,13 @@ the headline numbers behind:
 
 Everything lands in evidence/ (JSON + logs); a summary is appended to
 evidence/EVIDENCE.md. Run directly or via scripts/tpu_watch.py --evidence.
+``--sections a,b,c`` runs a subset (e.g. just the pieces a mid-run tunnel
+flap lost), most-important-first order preserved.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -29,6 +32,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EVID = os.path.join(REPO, "evidence")
+
+CPU_MESH_ENV = {"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
 
 
 def _now() -> str:
@@ -61,17 +67,31 @@ def _run(name: str, cmd: list, env: dict | None = None,
 
 
 def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sections", default="",
+                    help="comma-separated subset to run (default: all): "
+                         "bench,pallas_mosaic,flash_vs_xla,alexnet_realshape,"
+                         "time_per_layer,comm_validation,dwbp_schedule,"
+                         "dwbp_overlap")
+    args = ap.parse_args()
+    wanted = set(s for s in args.sections.split(",") if s)
+
+    def want(name: str) -> bool:
+        return not wanted or name in wanted
+
     os.makedirs(EVID, exist_ok=True)
     trace_dir = os.path.join(EVID, "xplane")
     results = []
 
     # 1 — the headline bench, with trace capture for the overlap analysis
-    bench_res = _run(
-        "bench", [sys.executable, "bench.py"],
-        env={"POSEIDON_BENCH_TRACE": trace_dir,
-             "POSEIDON_BENCH_BUDGET_S": "1500"},
-        timeout=2400)
-    results.append(bench_res)
+    bench_res: dict = {"rc": 1}
+    if want("bench"):
+        bench_res = _run(
+            "bench", [sys.executable, "bench.py"],
+            env={"POSEIDON_BENCH_TRACE": trace_dir,
+                 "POSEIDON_BENCH_BUDGET_S": "1500"},
+            timeout=2400)
+        results.append(bench_res)
 
     # 1b — DWBP escalation: if the A/B shows no overlap win, retry with
     # XLA's latency-hiding scheduler + async collectives explicitly on
@@ -111,57 +131,79 @@ def main() -> int:
     # 2 — Mosaic-compile the Pallas kernels on hardware (the conftest pins
     # CPU unless POSEIDON_TEST_TPU=1; on the tpu backend interpret=False is
     # the kernels' default, i.e. real Mosaic compilation)
-    results.append(_run(
-        "pallas_mosaic",
-        [sys.executable, "-m", "pytest", "tests/test_pallas.py", "-q",
-         "--no-header"],
-        env={"POSEIDON_TEST_TPU": "1"},
-        timeout=1800))
+    if want("pallas_mosaic"):
+        results.append(_run(
+            "pallas_mosaic",
+            [sys.executable, "-m", "pytest", "tests/test_pallas.py", "-q",
+             "--no-header"],
+            env={"POSEIDON_TEST_TPU": "1"},
+            timeout=1800))
 
     # 2b — flash-vs-XLA attention table
-    results.append(_run(
-        "flash_vs_xla",
-        [sys.executable, "scripts/bench_flash_attention.py"],
-        timeout=1800))
+    if want("flash_vs_xla"):
+        results.append(_run(
+            "flash_vs_xla",
+            [sys.executable, "scripts/bench_flash_attention.py"],
+            timeout=1800))
 
     # 3 — real-shape AlexNet
-    results.append(_run(
-        "alexnet_realshape",
-        [sys.executable, "scripts/run_alexnet_realshape.py", "--steps", "3"],
-        timeout=1800))
+    if want("alexnet_realshape"):
+        results.append(_run(
+            "alexnet_realshape",
+            [sys.executable, "scripts/run_alexnet_realshape.py",
+             "--steps", "3"],
+            timeout=1800))
 
     # 3b — per-layer fwd/bwd timing on hardware (the `caffe time` analog;
-    # needs the synthetic ILSVRC12-shaped DB for real input shapes)
-    if not os.path.isdir(os.path.join(
-            REPO, "examples/imagenet/ilsvrc12_train_lmdb")):
-        _run("make_imagenet_db",
-             [sys.executable, "examples/make_synthetic_db.py", "imagenet",
-              "--train", "64", "--test", "16"],
-             timeout=900)
-    results.append(_run(
-        "time_per_layer",
-        [sys.executable, "-m", "poseidon_tpu", "time",
-         "--model", "examples/imagenet/alexnet_train_val.prototxt",
-         "--iterations", "5", "--per_layer"],
-        timeout=1200))
+    # needs the synthetic ILSVRC12-shaped DB for real input shapes).
+    # Compile-dominated over the tunnel: ~21 layers x fwd+grad jits.
+    if want("time_per_layer"):
+        if not os.path.isdir(os.path.join(
+                REPO, "examples/imagenet/ilsvrc12_train_lmdb")):
+            _run("make_imagenet_db",
+                 [sys.executable, "examples/make_synthetic_db.py", "imagenet",
+                  "--train", "64", "--test", "16"],
+                 timeout=900)
+        results.append(_run(
+            "time_per_layer",
+            [sys.executable, "-m", "poseidon_tpu", "time",
+             "--model", "examples/imagenet/alexnet_train_val.prototxt",
+             "--iterations", "3", "--per_layer"],
+            timeout=2400))
 
-    # 3c — static comm table vs the TPU-compiled program (async collective
-    # forms exercised on real HLO)
-    results.append(_run(
-        "comm_validation",
-        [sys.executable, "scripts/validate_comm_stats.py",
-         "--model", "alexnet", "--batch", "32", "--image", "227"],
-        timeout=1200))
+    # 3c — static comm table vs the compiled program. Runs on the 8-device
+    # VIRTUAL mesh: the tunneled TPU is a 1-device mesh, which emits no
+    # collectives at all — there is nothing to validate there (the first
+    # capture confirmed this the hard way)
+    if want("comm_validation"):
+        results.append(_run(
+            "comm_validation",
+            [sys.executable, "scripts/validate_comm_stats.py",
+             "--model", "alexnet", "--batch", "32", "--image", "227",
+             "--cpu"],
+            env=CPU_MESH_ENV,
+            timeout=1200))
+
+    # 3d — DWBP mechanism from the compiled 8-device schedule (CPU mesh;
+    # the 1-chip TPU trace in 4 has no collectives to analyze)
+    if want("dwbp_schedule"):
+        results.append(_run(
+            "dwbp_schedule",
+            [sys.executable, "scripts/analyze_schedule.py"],
+            env=CPU_MESH_ENV,
+            timeout=900))
 
     # 4 — overlap proof from the trace
-    results.append(_run(
-        "dwbp_overlap",
-        [sys.executable, "scripts/analyze_overlap.py", trace_dir],
-        timeout=600))
+    if want("dwbp_overlap"):
+        results.append(_run(
+            "dwbp_overlap",
+            [sys.executable, "scripts/analyze_overlap.py", trace_dir],
+            timeout=600))
 
     ok = sum(1 for r in results if r["rc"] == 0)
     with open(os.path.join(EVID, "EVIDENCE.md"), "a") as f:
-        f.write(f"\n## Capture at {_now()} — {ok}/{len(results)} sections ok\n\n")
+        f.write(f"\n## Capture at {_now()} — {ok}/{len(results)} "
+                f"sections ok\n\n")
         for r in results:
             f.write(f"- **{r['name']}**: rc={r['rc']} ({r['seconds']}s)\n")
             for line in r.get("stdout_tail", [])[-3:]:

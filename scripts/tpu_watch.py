@@ -47,6 +47,9 @@ def main() -> None:
                          "(scripts/tpu_evidence.py) instead of bench.py "
                          "alone: bench + Mosaic pallas + flash table + "
                          "real-shape AlexNet + overlap proof")
+    ap.add_argument("--sections", default="",
+                    help="with --evidence: comma-separated subset of "
+                         "capture sections to run")
     args = ap.parse_args()
 
     while True:
@@ -58,9 +61,12 @@ def main() -> None:
                   flush=True)
             target = (os.path.join(REPO, "scripts", "tpu_evidence.py")
                       if args.evidence else os.path.join(REPO, "bench.py"))
+            cmd = [sys.executable, target]
+            if args.evidence and args.sections:
+                cmd += ["--sections", args.sections]
             try:
                 r = subprocess.run(
-                    [sys.executable, target],
+                    cmd,
                     capture_output=True, text=True,
                     timeout=3600 if not args.evidence else 9000, cwd=REPO)
             except subprocess.TimeoutExpired:
