@@ -265,6 +265,13 @@ def main() -> None:
     if layout:
         config.set_policy(conv_layout=layout)
         extras["conv_layout"] = layout
+    # POSEIDON_BENCH_S2D=1 takes the headline with the space-to-depth stem
+    # rewrite (exact; see ops/nn._space_to_depth_rewrite) — use when the
+    # A/B below showed it wins
+    s2d = os.environ.get("POSEIDON_BENCH_S2D", "") == "1"
+    if s2d:
+        config.set_policy(conv_s2d=True)
+        extras["conv_s2d"] = True
 
     # K optimizer steps per dispatch: the runtime's per-dispatch round-trip
     # (~720 ms through the axon tunnel, measured round 3) must not masquerade
@@ -389,6 +396,19 @@ def main() -> None:
             extras["nhwc_step_ms"] = round(nhwc_s * 1e3, 3)
             extras["nhwc_speedup"] = round(step_s / nhwc_s, 4)
             del ts3, p3, s3, b3
+
+        # ---- Stem space-to-depth A/B: conv1 uses 3 of 128 MXU lanes -------
+        if os.environ.get("POSEIDON_BENCH_S2D_AB", "1") == "1" and \
+                not s2d and budget_left("s2d_ab"):
+            with config.policy_scope(conv_s2d=True):
+                ts5, p5, s5, b5 = _build(
+                    "alexnet", per_dev_batch, image, classes,
+                    {"fc6": SFB, "fc7": SFB}, scan_steps=scan)
+                s2d_s, *_ = _time_step(ts5, p5, s5, b5, max(3, iters // 5))
+            s2d_s = _device_est(s2d_s, "s2d_ab")
+            extras["s2d_step_ms"] = round(s2d_s * 1e3, 3)
+            extras["s2d_speedup"] = round(step_s / s2d_s, 4)
+            del ts5, p5, s5, b5
 
         # ---- TOPK selection cost at fc6 scale: global vs blocked ----------
         if os.environ.get("POSEIDON_BENCH_TOPK",

@@ -29,6 +29,12 @@ class Policy:
     # TPU-preferred channels-last layout — the transposes sit at op
     # boundaries where XLA's layout assignment can cancel chains of them.
     conv_layout: str = "NCHW"
+    # Space-to-depth stem transform: rewrite few-channel strided convs
+    # (AlexNet/GoogLeNet conv1: 3 input channels use 3/128 MXU lanes) as an
+    # exact stride-1 conv over s*s-times more channels. Mathematically
+    # exact up to float summation order; off by default so golden-value
+    # tests compare the direct formulation.
+    conv_s2d: bool = False
 
 
 _policy = Policy()

@@ -40,6 +40,50 @@ def conv_out_size(in_size: int, kernel: int, stride: int, pad: int) -> int:
     return (in_size + 2 * pad - kernel) // stride + 1
 
 
+def _space_to_depth_rewrite(x, w, stride, pad):
+    """Exact rewrite of a few-channel strided conv as a stride-1 conv over
+    s*s-times more channels (the MLPerf-era stem trick, here generalized).
+
+    A 3-channel conv1 uses 3 of the MXU's 128 input lanes; AlexNet's
+    11x11/s4 stem and GoogLeNet's 7x7/s2 stem are lane-starved, not
+    FLOP-bound. Rearranging each s x s input block into channels and
+    zero-padding the kernel to a multiple of s gives the identical sum —
+    out(i,j) = sum_{c,u,v} w[o,c,u,v] x[c, si+u, sj+v] with u = s*di+ph,
+    v = s*dj+pw — so the transform is exact up to float summation order.
+
+    Returns (x2, w2) for a stride-1, pad-0 conv producing the same output.
+    """
+    s = stride[0]
+    n, c, h, wd = x.shape
+    o, _, kh, kw = w.shape
+    out_h = conv_out_size(h, kh, s, pad[0])
+    out_w = conv_out_size(wd, kw, s, pad[1])
+    k2h = -(-kh // s) * s
+    k2w = -(-kw // s) * s
+    # explicit conv padding, then crop/pad to exactly the rows/cols the
+    # out_h/out_w windows touch: s*(out-1) + k2
+    need_h = s * (out_h - 1) + k2h
+    need_w = s * (out_w - 1) + k2w
+    xp = jnp.pad(x, ((0, 0), (0, 0),
+                     (pad[0], max(need_h - h - pad[0], 0)),
+                     (pad[1], max(need_w - wd - pad[1], 0))))
+    xp = xp[:, :, :need_h, :need_w]
+    x2 = xp.reshape(n, c, need_h // s, s, need_w // s, s)
+    x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(
+        n, c * s * s, need_h // s, need_w // s)
+    wp = jnp.pad(w, ((0, 0), (0, 0), (0, k2h - kh), (0, k2w - kw)))
+    w2 = wp.reshape(o, c, k2h // s, s, k2w // s, s)
+    w2 = w2.transpose(0, 1, 3, 5, 2, 4).reshape(
+        o, c * s * s, k2h // s, k2w // s)
+    return x2, w2
+
+
+def _s2d_applicable(x, w, stride, group) -> bool:
+    return (policy().conv_s2d and group == 1 and
+            stride[0] == stride[1] and stride[0] >= 2 and
+            x.shape[1] <= 4 and w.shape[2] >= stride[0])
+
+
 def conv2d(
     x: jax.Array,
     w: jax.Array,
@@ -57,6 +101,10 @@ def conv2d(
     p = policy()
     xc = x.astype(p.compute_dtype)
     wc = w.astype(p.compute_dtype)
+    if _s2d_applicable(xc, wc, stride, group):
+        xc, wc = _space_to_depth_rewrite(xc, wc, stride, pad)
+        stride = (1, 1)
+        pad = (0, 0)
     padding = [(pad[0], pad[0]), (pad[1], pad[1])]
     if p.conv_layout == "NHWC":
         y = lax.conv_general_dilated(

@@ -103,18 +103,28 @@ def main() -> int:
         overlap = float(line.get("dwbp_overlap_speedup", 0) or 0)
     except Exception:  # noqa: BLE001
         overlap = 0.0
-    # 1c — layout escalation: if channels-last won the A/B, retake the
-    # headline with it (the final number should be the best config)
-    try:
-        nhwc = float(line.get("nhwc_speedup", 0) or 0)
-    except Exception:  # noqa: BLE001
-        nhwc = 0.0
-    if bench_res["rc"] == 0 and nhwc > 1.05:
+    # 1c — best-config escalation: if the layout and/or stem A/Bs won,
+    # retake the headline ONCE with every winning knob on (and both A/Bs
+    # off — their answers are already known from the main run)
+    def _speedup(key: str) -> float:
+        try:
+            return float(line.get(key, 0) or 0)
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    best_env = {}
+    if _speedup("nhwc_speedup") > 1.05:
+        best_env["POSEIDON_BENCH_LAYOUT"] = "NHWC"
+    if _speedup("s2d_speedup") > 1.05:
+        best_env["POSEIDON_BENCH_S2D"] = "1"
+    if bench_res["rc"] == 0 and best_env:
         results.append(_run(
-            "bench_nhwc", [sys.executable, "bench.py"],
-            env={"POSEIDON_BENCH_LAYOUT": "NHWC",
+            "bench_best", [sys.executable, "bench.py"],
+            env={**best_env,
                  "POSEIDON_BENCH_BUDGET_S": "900",
-                 "POSEIDON_BENCH_LM": "0"},
+                 "POSEIDON_BENCH_LM": "0",
+                 "POSEIDON_BENCH_LAYOUT_AB": "0",
+                 "POSEIDON_BENCH_S2D_AB": "0"},
             timeout=1500))
 
     if bench_res["rc"] == 0 and 0 < overlap < 1.02:

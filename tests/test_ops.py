@@ -184,3 +184,52 @@ def test_dropout_scaling(rng_np):
     np.testing.assert_allclose(kept, 1.0 / 0.6, rtol=1e-5)
     assert abs(len(kept) / 1000 - 0.6) < 0.08
     np.testing.assert_allclose(np.asarray(E.dropout(x, 0.4, None, False)), x)
+
+
+@pytest.mark.parametrize("c,k,s,p,h", [
+    (3, 11, 4, 0, 227),   # AlexNet conv1
+    (3, 7, 2, 3, 49),     # GoogLeNet conv1 shape family (reduced spatial)
+    (1, 5, 2, 1, 17),     # k not divisible by s, odd sizes
+    (4, 4, 4, 2, 19),     # k == s with padding
+])
+def test_conv_space_to_depth_exact(rng_np, c, k, s, p, h):
+    """The s2d stem rewrite is the identical sum re-bracketed: forward and
+    backward must match the direct conv to float tolerance."""
+    import jax
+    from poseidon_tpu.config import policy_scope
+    x = rng_np.randn(2, c, h, h).astype(np.float32)
+    w = rng_np.randn(8, c, k, k).astype(np.float32)
+    b = rng_np.randn(8).astype(np.float32)
+
+    def loss(args):
+        xx, ww, bb = args
+        return (NN.conv2d(xx, ww, bb, (s, s), (p, p), 1) ** 2).sum()
+
+    y1 = np.asarray(NN.conv2d(x, w, b, (s, s), (p, p), 1))
+    g1 = jax.grad(loss)((x, w, b))
+    with policy_scope(conv_s2d=True):
+        y2 = np.asarray(NN.conv2d(x, w, b, (s, s), (p, p), 1))
+        g2 = jax.grad(loss)((x, w, b))
+    assert y1.shape == y2.shape
+    np.testing.assert_allclose(y2, y1, rtol=1e-5, atol=5e-5)
+    # grads re-bracket ~k*k*O-term float sums; tolerance covers order noise
+    for a, c_, name in zip(g1, g2, "xwb"):
+        np.testing.assert_allclose(np.asarray(c_), np.asarray(a),
+                                   rtol=1e-3, atol=3e-4, err_msg=name)
+
+
+def test_conv_space_to_depth_skips_many_channel_convs(rng_np):
+    """The rewrite must only fire on lane-starved stems (C <= 4)."""
+    import jax.numpy as jnp
+    from poseidon_tpu.ops.nn import _s2d_applicable
+    from poseidon_tpu.config import policy_scope
+    x8 = jnp.zeros((1, 8, 9, 9))
+    x3 = jnp.zeros((1, 3, 9, 9))
+    w8 = jnp.zeros((4, 8, 3, 3))
+    w3 = jnp.zeros((4, 3, 3, 3))
+    with policy_scope(conv_s2d=True):
+        assert not _s2d_applicable(x8, w8, (2, 2), 1)   # enough lanes
+        assert not _s2d_applicable(x3, w3, (1, 1), 1)   # stride 1
+        assert not _s2d_applicable(x3, w3, (2, 2), 3)   # grouped
+        assert _s2d_applicable(x3, w3, (2, 2), 1)
+    assert not _s2d_applicable(x3, w3, (2, 2), 1)       # knob off
