@@ -118,6 +118,7 @@ def build_train_step(
     donate: bool = True,
     dump_blobs: Optional[list] = None,
     scan_steps: Optional[int] = None,
+    scan_reuse_batch: bool = False,
 ) -> TrainStep:
     """Compiled SPMD train step over ``mesh``.
 
@@ -143,7 +144,14 @@ def build_train_step(
     (the reference pays this per-iteration cost in Solver::Step,
     solver.cpp:405-531; on a remote/tunneled or multi-host runtime the
     round-trip dominates). Incompatible with ``dump_blobs`` (stacking K
-    copies of every activation would defeat the memory plan)."""
+    copies of every activation would defeat the memory plan).
+
+    ``scan_reuse_batch=True`` (benchmarking mode) drops the leading [K]
+    batch axis and feeds the SAME batch to every scan iteration: per-step
+    compute is shape-identical to training, parameters still evolve through
+    the scan carry, but only one batch lives on device — this is what lets
+    K grow large enough to amortize a multi-second runtime dispatch
+    round-trip without K x 158 MB of stacked images."""
     comm = comm or CommConfig()
     comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
@@ -240,18 +248,25 @@ def build_train_step(
         def device_multi_step(params, state, batches, rng):
             def body(carry, xs):
                 p, s = carry
-                i, batch = xs
+                if scan_reuse_batch:
+                    i, batch = xs, batches
+                else:
+                    i, batch = xs
                 p, s, m, _ = device_step(p, s, batch,
                                          jax.random.fold_in(rng, i))
                 return (p, s), m
-            (params, state), ms = lax.scan(
-                body, (params, state),
-                (jnp.arange(scan_steps), batches))
+            xs = (jnp.arange(scan_steps) if scan_reuse_batch
+                  else (jnp.arange(scan_steps), batches))
+            (params, state), ms = lax.scan(body, (params, state), xs)
             return params, state, ms
 
         # leading [K] axis is unsharded; the per-step batch axis keeps the
-        # single-step sharding
-        scan_batch_spec = P(None, *batch_spec)
+        # single-step sharding. scan_reuse_batch feeds the SAME batch to
+        # every scan iteration (per-step compute is shape-identical, params
+        # still evolve through the carry) — the benchmarking mode that keeps
+        # K large without K on-device batch copies.
+        scan_batch_spec = (P(*batch_spec) if scan_reuse_batch
+                           else P(None, *batch_spec))
         sharded = jax.shard_map(
             device_multi_step,
             mesh=mesh,

@@ -112,3 +112,36 @@ def test_scan_rejects_dump_blobs(mesh, net):
     with pytest.raises(ValueError, match="scan_steps"):
         build_train_step(net, _sp(), mesh, CommConfig(), scan_steps=2,
                          dump_blobs=["ip1"])
+
+
+def test_scan_reuse_batch_matches_repeated_batch(mesh, net, rng_np):
+    """scan_reuse_batch=True == scan over K copies of the same batch: same
+    final params, same per-step losses, one on-device batch."""
+    comm = CommConfig()
+    params = net.init(jax.random.PRNGKey(0))
+    one = _batches(rng_np, k=1)[0]
+    rng = jax.random.PRNGKey(7)
+
+    tsk = build_train_step(net, _sp(), mesh, comm, donate=False,
+                           scan_steps=K)
+    stacked = stack_batches([one] * K, tsk.batch_sharding)
+    pk, sk, mk = tsk.step(params, init_train_state(params, comm, N_DEV),
+                          stacked, rng)
+
+    tsr = build_train_step(net, _sp(), mesh, comm, donate=False,
+                           scan_steps=K, scan_reuse_batch=True)
+    single = {k: jax.device_put(jnp.asarray(v), tsr.batch_sharding)
+              for k, v in one.items()}
+    assert single["data"].shape == (BATCH, 1, 28, 28)  # no [K] axis
+    pr, sr, mr = tsr.step(params, init_train_state(params, comm, N_DEV),
+                          single, rng)
+
+    assert mr["loss"].shape == (K,)
+    np.testing.assert_allclose(np.asarray(mr["loss"]),
+                               np.asarray(mk["loss"]), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6),
+        pr, pk)
+    # params actually evolved (it's K optimizer steps, not one)
+    assert int(sr.solver.it) == K
