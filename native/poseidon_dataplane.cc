@@ -212,24 +212,38 @@ inline uint64_t mix(uint64_t x) {
   return x ^ (x >> 31);
 }
 
+// Crop offsets + mirror decision for one record. BOTH batch paths (f32
+// host-transform and u8 device-transform) derive augmentation from this one
+// function, so the two pipelines see identical pixels for a given seed —
+// the parity contract tests/test_native.py::test_native_u8_matches_f32_pixels
+// checks.
+struct Aug { int h_off, w_off; bool do_mirror; };
+
+Aug compute_aug(uint64_t seed, int H, int W, int crop, bool train,
+                bool mirror) {
+  Aug a{0, 0, false};
+  if (crop) {
+    if (train) {
+      uint64_t r = mix(seed);
+      a.h_off = (int)(r % (uint64_t)(H - crop + 1));
+      a.w_off = (int)(mix(r) % (uint64_t)(W - crop + 1));
+    } else {
+      a.h_off = (H - crop) / 2;
+      a.w_off = (W - crop) / 2;
+    }
+  }
+  if (mirror && train) a.do_mirror = (mix(seed ^ 0xABCDu) & 1) != 0;
+  return a;
+}
+
 void transform_one(const DatumView& d, const TransformSpec& t, uint64_t seed,
                    float* out) {
   const int C = d.channels, H = d.height, W = d.width;
   const int crop = t.crop_size ? t.crop_size : 0;
   const int oh = crop ? crop : H, ow = crop ? crop : W;
-  int h_off = 0, w_off = 0;
-  bool do_mirror = false;
-  if (crop) {
-    if (t.train) {
-      uint64_t r = mix(seed);
-      h_off = (int)(r % (uint64_t)(H - crop + 1));
-      w_off = (int)(mix(r) % (uint64_t)(W - crop + 1));
-    } else {
-      h_off = (H - crop) / 2;
-      w_off = (W - crop) / 2;
-    }
-  }
-  if (t.mirror && t.train) do_mirror = (mix(seed ^ 0xABCDu) & 1) != 0;
+  Aug a = compute_aug(seed, H, W, crop, t.train != 0, t.mirror != 0);
+  const int h_off = a.h_off, w_off = a.w_off;
+  const bool do_mirror = a.do_mirror;
 
   for (int c = 0; c < C; ++c) {
     for (int h = 0; h < oh; ++h) {
@@ -318,6 +332,64 @@ int32_t pdp_batch(void* h, const int64_t* indices, int32_t n,
       out_labels[i] = d.label;
       transform_one(d, *spec, mix(seed ^ (uint64_t)indices[i]),
                     out_data + (size_t)i * rec);
+    }
+  };
+  for (int t = 0; t < workers; ++t) threads.emplace_back(work);
+  for (auto& t : threads) t.join();
+  return status.load();
+}
+
+// uint8 batch: decode + crop + mirror ONLY — mean/scale move onto the
+// accelerator (fused into the first conv by XLA), and the host ships 4x
+// fewer bytes. Only byte-backed Datums qualify (float_data records return
+// -4 so the caller can fall back to the f32 path). Same crop/mirror RNG
+// stream as transform_one, so u8-on-device == f32-on-host exactly.
+int32_t pdp_batch_u8(void* h, const int64_t* indices, int32_t n,
+                     int32_t crop_size, int32_t mirror, int32_t train,
+                     uint64_t seed, uint8_t* out_data, int32_t* out_labels,
+                     int32_t n_threads) {
+  auto* db = (Db*)h;
+  const int C = db->channels;
+  if (crop_size && (crop_size > db->height || crop_size > db->width))
+    return -3;
+  const int H = db->height, W = db->width;
+  const int oh = crop_size ? crop_size : H;
+  const int ow = crop_size ? crop_size : W;
+  const size_t rec = (size_t)C * oh * ow;
+  const int64_t n_records = (int64_t)db->index.size();
+  std::atomic<int32_t> status{0};
+  int workers = std::max(1, std::min<int>(n_threads, n));
+  std::vector<std::thread> threads;
+  std::atomic<int32_t> next{0};
+  auto work = [&]() {
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n) return;
+      if (indices[i] < 0 || indices[i] >= n_records) { status.store(-2); return; }
+      auto loc = db->index[(size_t)indices[i]];
+      DatumView d = parse_datum(leaf_value(*db, loc.first, loc.second));
+      if (!d.ok || d.channels != C || d.height != H || d.width != W) {
+        status.store(-1); return;
+      }
+      if (!d.bytes.size) { status.store(-4); return; }  // float_data record
+      out_labels[i] = d.label;
+      Aug a = compute_aug(mix(seed ^ (uint64_t)indices[i]), H, W, crop_size,
+                          train != 0, mirror != 0);
+      const int h_off = a.h_off, w_off = a.w_off;
+      const bool do_mirror = a.do_mirror;
+      uint8_t* out = out_data + (size_t)i * rec;
+      for (int c = 0; c < C; ++c) {
+        for (int hh = 0; hh < oh; ++hh) {
+          const uint8_t* src_row =
+              d.bytes.data + ((size_t)c * H + hh + h_off) * W + w_off;
+          uint8_t* dst_row = out + ((size_t)c * oh + hh) * ow;
+          if (!do_mirror) {
+            memcpy(dst_row, src_row, (size_t)ow);
+          } else {
+            for (int w = 0; w < ow; ++w) dst_row[ow - 1 - w] = src_row[w];
+          }
+        }
+      }
     }
   };
   for (int t = 0; t < workers; ++t) threads.emplace_back(work);

@@ -74,6 +74,15 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,
         ]
         lib.pdp_close.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "pdp_batch_u8"):  # stale prebuilt .so tolerance
+            lib.pdp_batch_u8.restype = ctypes.c_int32
+            lib.pdp_batch_u8.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ]
         # newer symbol: a stale prebuilt .so may predate it — the batcher
         # must keep working, only the snappy fast path degrades
         if hasattr(lib, "pdp_snappy_uncompress"):
@@ -175,6 +184,36 @@ class NativeLMDBBatcher:
 
     def __len__(self) -> int:
         return self.n
+
+    def supports_u8(self) -> bool:
+        return hasattr(self._lib, "pdp_batch_u8")
+
+    def batch_u8(self, indices: np.ndarray,
+                 seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode + crop + mirror to uint8 — mean/scale happen on device
+        (see pipeline.device_transform). Same crop/mirror RNG stream as
+        ``batch``, so the two paths see identical pixels. Raises IOError
+        on float_data-backed records (rc=-4): callers fall back to f32."""
+        idx = np.ascontiguousarray(indices, np.int64)
+        n = len(idx)
+        data = np.empty((n,) + self.out_shape, np.uint8)
+        labels = np.empty((n,), np.int32)
+        rc = self._lib.pdp_batch_u8(
+            self._h, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            self._spec.crop_size, self._spec.mirror, self._spec.train,
+            ctypes.c_uint64(seed),
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self.n_threads)
+        if rc == -2:
+            raise IndexError("batch index out of range")
+        if rc == -3:
+            raise ValueError("crop_size exceeds record dimensions")
+        if rc == -4:
+            raise IOError("float_data records cannot ship as uint8")
+        if rc != 0:
+            raise IOError(f"native batch failed: bad record (rc={rc})")
+        return data, labels
 
     def batch(self, indices: np.ndarray,
               seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:

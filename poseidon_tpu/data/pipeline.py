@@ -108,6 +108,7 @@ class BatchPipeline:
         shuffle: Optional[bool] = None,
         memory_data: Optional[Dict[str, np.ndarray]] = None,
         use_native: bool = True,
+        device_transform: bool = False,
     ):
         self.lp = lp
         self.phase = phase
@@ -116,6 +117,14 @@ class BatchPipeline:
         self.seed = seed
         self.shuffle = (phase == "TRAIN") if shuffle is None else shuffle
         self.tops = list(lp.top)
+        # device_transform: ship uint8 crops and let the compiled step do
+        # (x - mean) * scale on the accelerator — 4x fewer host->device
+        # bytes and no per-pixel float math on the host (the TPU-native
+        # split of DataTransformer's work). Engaged only when the native
+        # batcher supports it; ``device_transform_spec`` is then the
+        # {mean, scale} the training side must apply.
+        self.device_transform_spec: Optional[Dict] = None
+        self._want_device_transform = device_transform
 
         self.window = None
         if lp.canonical_type() == "WINDOW_DATA":
@@ -132,10 +141,33 @@ class BatchPipeline:
             self._thread.start()
             return
         self.native = self._try_native(lp, phase, shard) if use_native else None
+        self._u8 = False
         if self.native is not None:
             self.source = None
             self._n_records = len(self.native)
             self.data_shape = (batch_size,) + self.native.out_shape
+            tp = _effective_transform(lp)
+            # exactness constraint: a full mean_file is subtracted at the
+            # per-sample SOURCE crop position (data_transformer.cpp indexes
+            # the mean by h_off/w_off), which the device cannot see — only
+            # mean_value/no-mean configs move on-device
+            if (self._want_device_transform and not tp.mean_file
+                    and self.native.supports_u8()):
+                # probe one record: float_data-backed Datums cannot ship as
+                # uint8 (rc=-4) — fall back to the host f32 path instead of
+                # crashing the prefetch worker on the first real batch
+                try:
+                    self.native.batch_u8(np.zeros(1, np.int64))
+                    self._u8 = True
+                except IOError:
+                    self._u8 = False
+            if self._u8:
+                mv = (np.asarray(tp.mean_value, np.float32)
+                      if tp.mean_value else None)
+                if mv is not None and mv.size == 1:
+                    mv = np.repeat(mv, self.native.out_shape[0])
+                self.device_transform_spec = {
+                    "mean_values": mv, "scale": float(tp.scale)}
         else:
             self.source = build_source(lp, shard, memory_data)
             self._n_records = len(self.source)
@@ -205,7 +237,9 @@ class BatchPipeline:
                                    for _ in range(self.batch_size)),
                                   np.int64, count=self.batch_size)
                 if self.native is not None:
-                    data, labels = self.native.batch(
+                    fetch = (self.native.batch_u8 if self._u8
+                             else self.native.batch)
+                    data, labels = fetch(
                         idx, seed=self.seed * 1_000_003 + batch_no)
                 else:
                     raw = np.empty(
@@ -246,7 +280,7 @@ class BatchPipeline:
 def build_phase_pipelines(net_param, phase: str, batch_multiplier: int,
                           shard: Shard = Shard(0, 1),
                           memory_data: Optional[Dict[str, np.ndarray]] = None,
-                          seed: int = 0):
+                          seed: int = 0, device_transform: bool = False):
     """Build a BatchPipeline per data layer of `net_param` at `phase`.
 
     Returns (pipelines, source_shapes) where source_shapes carry the
@@ -268,7 +302,8 @@ def build_phase_pipelines(net_param, phase: str, batch_multiplier: int,
         if per_dev <= 0:
             raise ValueError(f"layer {lp.name!r}: batch_size must be set")
         pipe = BatchPipeline(lp, phase, per_dev * batch_multiplier,
-                             shard=shard, memory_data=memory_data, seed=seed)
+                             shard=shard, memory_data=memory_data, seed=seed,
+                             device_transform=device_transform)
         pipes.append(pipe)
         shapes[lp.top[0]] = (per_dev,) + tuple(pipe.data_shape[1:])
         if len(lp.top) > 1:

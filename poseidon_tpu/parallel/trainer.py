@@ -119,6 +119,7 @@ def build_train_step(
     dump_blobs: Optional[list] = None,
     scan_steps: Optional[int] = None,
     scan_reuse_batch: bool = False,
+    input_transform: Optional[Callable] = None,
 ) -> TrainStep:
     """Compiled SPMD train step over ``mesh``.
 
@@ -151,7 +152,12 @@ def build_train_step(
     compute is shape-identical to training, parameters still evolve through
     the scan carry, but only one batch lives on device — this is what lets
     K grow large enough to amortize a multi-second runtime dispatch
-    round-trip without K x 158 MB of stacked images."""
+    round-trip without K x 158 MB of stacked images.
+
+    ``input_transform`` runs on the batch INSIDE the compiled step (per
+    scan iteration in scan mode) — the device half of the data plane's
+    uint8 split (pipeline.device_transform): (x - mean) * scale fuses into
+    the first conv, and the host ships quarter-width bytes."""
     comm = comm or CommConfig()
     comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
@@ -188,6 +194,8 @@ def build_train_step(
         if dcn:
             flat_idx = flat_idx + mesh.shape[axis] * lax.axis_index(dcn)
         rng = jax.random.fold_in(rng, flat_idx)
+        if input_transform is not None:
+            batch = input_transform(batch)
 
         def loss_fn(p):
             out = net.apply(p, batch, train=True, rng=rng, comm=ctx,
@@ -246,6 +254,11 @@ def build_train_step(
                 "defeat the memory plan")
 
         def device_multi_step(params, state, batches, rng):
+            # fold by GLOBAL iteration (solver.it at dispatch + offset), so
+            # the per-step rng stream is identical to single-step dispatches
+            # (callers fold by iteration there) for ANY K and any chunk
+            # boundary — dropout masks must not depend on dispatch grouping
+            it0 = state.solver.it
             def body(carry, xs):
                 p, s = carry
                 if scan_reuse_batch:
@@ -253,7 +266,7 @@ def build_train_step(
                 else:
                     i, batch = xs
                 p, s, m, _ = device_step(p, s, batch,
-                                         jax.random.fold_in(rng, i))
+                                         jax.random.fold_in(rng, it0 + i))
                 return (p, s), m
             xs = (jnp.arange(scan_steps) if scan_reuse_batch
                   else (jnp.arange(scan_steps), batches))

@@ -64,7 +64,8 @@ def _engine_from_args(args, phase_nets=True):
     staleness = getattr(args, "staleness", 0)
     return Engine(sp, comm=comm, mesh=mesh, output_dir=args.output_dir,
                   staleness=staleness, sfb_auto=args.sfb_auto,
-                  steps_per_dispatch=getattr(args, "steps_per_dispatch", 1))
+                  steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
+                  device_transform=getattr(args, "device_transform", False))
 
 
 def cmd_train(args) -> int:
@@ -439,6 +440,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "display/test/snapshot boundaries")
     t.add_argument("--profile", type=int, default=0,
                    help="capture an xplane trace over N steps (from step 10)")
+    t.add_argument("--device_transform", action="store_true",
+                   help="ship uint8 crops and apply (x - mean_value) * "
+                        "scale on device (4x fewer host->device bytes; "
+                        "needs the native batcher, mean_value-style mean)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="score a model")

@@ -81,6 +81,7 @@ class Engine:
         staleness: int = 0,
         sfb_auto: bool = False,
         steps_per_dispatch: int = 1,
+        device_transform: bool = False,
     ):
         self.sp = sp
         self.mesh = mesh or make_mesh()
@@ -91,6 +92,15 @@ class Engine:
         self.stats = StatsRegistry()
         self.rank = jax.process_index()
         self.memory_data = memory_data
+        # uint8 ingest + on-device (x - mean) * scale (the TPU-native split
+        # of DataTransformer): train pipelines ship quarter-width bytes and
+        # the normalization fuses into the compiled step. The SSP step
+        # builder has no input hook, so SSP keeps the host transform.
+        if device_transform and staleness > 0:
+            log("WARNING: device_transform not supported under SSP "
+                "staleness; keeping the host-side transform", rank=self.rank)
+            device_transform = False
+        self._device_transform = device_transform
 
         if sp.iter_size > 1:
             # parsed for V2-prototxt compat; the 2015 reference predates it
@@ -104,6 +114,13 @@ class Engine:
         self.train_pipelines, train_shapes = self._build_pipelines(
             train_param, "TRAIN")
         self.train_net = Net(train_param, "TRAIN", source_shapes=train_shapes)
+        self._input_transform = self._make_input_transform()
+        if self._device_transform and self._input_transform is None:
+            log("WARNING: --device_transform requested but no train data "
+                "layer is eligible (needs the native LMDB batcher, "
+                "byte-backed records, and mean_value-style mean — a "
+                "mean_file must stay host-side); using the host transform",
+                rank=self.rank)
 
         self.test_nets: List[Net] = []
         self.test_pipelines: List[List[BatchPipeline]] = []
@@ -161,8 +178,9 @@ class Engine:
                 lowerable=ssp_ts.lowerable)
         else:
             dump = sorted({b for _, bs in self._h5_train for b in bs})
-            self.train_step = build_train_step(self.train_net, sp, self.mesh,
-                                               self.comm, dump_blobs=dump)
+            self.train_step = build_train_step(
+                self.train_net, sp, self.mesh, self.comm, dump_blobs=dump,
+                input_transform=self._input_transform)
 
         # --- multi-step dispatch (scan chunks) ---------------------------- #
         # K optimizer steps per compiled dispatch: amortizes the runtime's
@@ -185,7 +203,8 @@ class Engine:
             else:
                 self._scan_step = build_train_step(
                     self.train_net, sp, self.mesh, self.comm,
-                    scan_steps=self.steps_per_dispatch)
+                    scan_steps=self.steps_per_dispatch,
+                    input_transform=self._input_transform)
         self.eval_steps = [
             build_eval_step(n, self.mesh, dcn_axis=self.comm.dcn_axis)
             for n in self.test_nets]
@@ -228,6 +247,8 @@ class Engine:
                 "to print; set display in the solver", rank=self.rank)
         elif sp.debug_info:
             def _debug(params, batch, rng):
+                if self._input_transform is not None:
+                    batch = self._input_transform(batch)
                 out = self.train_net.apply(
                     params, batch, train=True, rng=rng, keep_blobs=True)
                 grads = jax.grad(
@@ -254,7 +275,36 @@ class Engine:
         return build_phase_pipelines(
             net_param, phase, batch_multiplier=jax.local_device_count(),
             shard=Shard(self.rank, jax.process_count()),
-            memory_data=self.memory_data)
+            memory_data=self.memory_data,
+            device_transform=(self._device_transform and phase == "TRAIN"))
+
+    def _make_input_transform(self):
+        """The device half of the uint8 ingest split: per data-layer
+        (x - mean_values) * scale, traced into the compiled train step."""
+        specs = {p.tops[0]: p.device_transform_spec
+                 for p in self.train_pipelines
+                 if getattr(p, "device_transform_spec", None) is not None}
+        if not specs:
+            return None
+        frozen = {top: (None if s["mean_values"] is None
+                        else jnp.asarray(s["mean_values"], jnp.float32),
+                        float(s["scale"]))
+                  for top, s in specs.items()}
+
+        def transform(batch):
+            out = dict(batch)
+            for top, (mean, scale) in frozen.items():
+                if top not in out:
+                    continue
+                x = out[top].astype(jnp.float32)
+                if mean is not None:
+                    x = x - mean.reshape(1, -1, 1, 1)
+                if scale != 1.0:
+                    x = x * scale
+                out[top] = x
+            return out
+
+        return transform
 
     def _next_batch(self, pipes: List[BatchPipeline]):
         batch: Dict[str, jax.Array] = {}
@@ -430,9 +480,11 @@ class Engine:
             if chunk > 1:
                 batch = self._next_batch_stack(self.train_pipelines, chunk)
                 t0 = time.time()
+                # the scan step folds rng by global iteration internally
+                # (solver.it + offset): pass the session rng unfolded so a
+                # chunked run's per-step streams match single-step dispatch
                 self.params, self.state, m = self._scan_step.step(
-                    self.params, self.state, batch,
-                    jax.random.fold_in(self.rng, it))
+                    self.params, self.state, batch, self.rng)
                 it += chunk
                 at_display = bool(sp.display) and it % sp.display == 0
             else:

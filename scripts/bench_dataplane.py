@@ -94,6 +94,23 @@ def main() -> None:
             payload["images_per_sec_per_core"] = round(
                 native_ips / max(1, b.n_threads), 1)
             assert data.shape == (args.batch, 3, 227, 227)
+
+            # uint8 device-transform path (pipeline.device_transform): the
+            # host only decodes + crops + mirrors; mean/scale ride the
+            # compiled step, and the transfer is quarter-width
+            if b.supports_u8():
+                b.batch_u8(idx, seed=0)
+                t0 = time.perf_counter()
+                for i in range(args.batches):
+                    idx = rs.randint(0, args.records, size=(args.batch,))
+                    u8, _ = b.batch_u8(idx, seed=i)
+                dt = time.perf_counter() - t0
+                u8_ips = args.batches * args.batch / dt
+                payload["u8_images_per_sec"] = round(u8_ips, 1)
+                payload["u8_speedup_vs_f32_host"] = round(
+                    u8_ips / native_ips, 2)
+                payload["u8_bytes_per_image"] = int(u8[0].nbytes)
+                payload["f32_bytes_per_image"] = int(data[0].nbytes)
             b.close()
         else:
             payload["error"] = "native data plane unavailable"
@@ -134,6 +151,9 @@ def main() -> None:
         payload["step_rate_images_per_sec"] = step_rate
         payload["ingest_over_consume"] = (
             round(payload["value"] / step_rate, 2) if step_rate else None)
+        if step_rate and payload.get("u8_images_per_sec"):
+            payload["u8_ingest_over_consume"] = round(
+                payload["u8_images_per_sec"] / step_rate, 2)
     except Exception as e:  # noqa: BLE001
         payload["error"] = f"{type(e).__name__}: {e}"
     finally:

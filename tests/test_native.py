@@ -137,3 +137,82 @@ def test_native_snappy_matches_python():
     import pytest
     with pytest.raises(ValueError):
         snappy_uncompress(bytes([200, 1]) + bytes([3 << 2]) + b"abcd")
+
+
+def test_native_u8_matches_f32_pixels(datum_db):
+    """batch_u8 (device-transform ingest) must pick the SAME crop/mirror
+    windows as batch under the same seed — only the mean/scale (moved
+    on-device) and dtype differ."""
+    path, _, _ = datum_db
+    b = native.NativeLMDBBatcher(path, crop_size=8, mirror=True, train=True)
+    assert b.supports_u8()
+    f32, l1 = b.batch(np.arange(16), seed=11)
+    u8, l2 = b.batch_u8(np.arange(16), seed=11)
+    assert u8.dtype == np.uint8
+    np.testing.assert_array_equal(u8.astype(np.float32), f32)
+    np.testing.assert_array_equal(l1, l2)
+    b.close()
+
+
+def test_pipeline_device_transform_spec(datum_db):
+    """device_transform: uint8 batches + the {mean, scale} spec the step
+    must apply; a mean_file config keeps the host path (per-sample crop
+    alignment of the full mean cannot be reproduced on device)."""
+    path, _, _ = datum_db
+    from poseidon_tpu.data.pipeline import BatchPipeline
+    from poseidon_tpu.proto.messages import (DataParameter, LayerParameter,
+                                             TransformationParameter)
+
+    lp = LayerParameter(
+        name="d", type="DATA", top=["data", "label"],
+        data_param=DataParameter(source=path, batch_size=8, backend="LMDB"),
+        transform_param=TransformationParameter(
+            crop_size=8, mirror=True, scale=0.00390625,
+            mean_value=[33.0, 34.0, 35.0]))
+    pipe = BatchPipeline(lp, "TRAIN", 8, device_transform=True)
+    assert pipe.device_transform_spec is not None
+    batch = next(pipe)
+    assert batch["data"].dtype == np.uint8
+    spec = pipe.device_transform_spec
+    np.testing.assert_array_equal(spec["mean_values"], [33.0, 34.0, 35.0])
+    assert abs(spec["scale"] - 0.00390625) < 1e-12
+    pipe.close()
+
+    # host path and device path agree end to end (same seed): the uint8
+    # batch put through the spec equals the host-transformed batch
+    pipe_h = BatchPipeline(lp, "TRAIN", 8, device_transform=False)
+    host = next(pipe_h)
+    dev = (batch["data"].astype(np.float32)
+           - np.asarray(spec["mean_values"])[None, :, None, None]) \
+        * spec["scale"]
+    np.testing.assert_allclose(dev, host["data"], rtol=1e-6, atol=1e-6)
+    pipe_h.close()
+
+
+def test_pipeline_device_transform_falls_back_for_float_data(tmp_path):
+    """float_data Datums cannot ship as uint8: the init-time probe must
+    disable the u8 path (host f32 transform) instead of crashing the
+    prefetch worker on the first batch."""
+    from poseidon_tpu.data.lmdb_reader import LMDBWriter
+    from poseidon_tpu.data.pipeline import BatchPipeline
+    from poseidon_tpu.proto.messages import DataParameter, LayerParameter
+    from poseidon_tpu.proto.wire import Datum, encode_datum
+
+    path = str(tmp_path / "float_lmdb")
+    w = LMDBWriter(path)
+    rs = np.random.RandomState(3)
+    for i in range(8):
+        arr = rs.rand(2, 6, 6).astype(np.float32)
+        w.put(f"{i:08d}".encode(),
+              encode_datum(Datum(2, 6, 6, b"", label=i % 3,
+                                 float_data=arr.ravel().tolist())))
+    w.close()
+
+    lp = LayerParameter(
+        name="d", type="DATA", top=["data", "label"],
+        data_param=DataParameter(source=path, batch_size=4, backend="LMDB"))
+    pipe = BatchPipeline(lp, "TRAIN", 4, device_transform=True)
+    assert pipe.device_transform_spec is None
+    batch = next(pipe)
+    assert batch["data"].dtype == np.float32
+    pipe.close()

@@ -438,3 +438,73 @@ def test_engine_steps_per_dispatch_ssp_falls_back(tmp_path):
         assert eng._scan_step is None and eng.steps_per_dispatch == 1
     finally:
         eng.close()
+
+
+def test_engine_device_transform_matches_host_path(tmp_path):
+    """--device_transform (uint8 ingest + on-device (x-mean)*scale) must
+    train IDENTICALLY to the host-transform path: same pipeline seed picks
+    the same crops/mirrors, and the normalization arithmetic is the same
+    f32 math on either side of the transfer."""
+    import jax
+    from poseidon_tpu.data.lmdb_reader import LMDBWriter
+    from poseidon_tpu.proto.wire import Datum, encode_datum
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.engine import Engine
+
+    db = str(tmp_path / "train_lmdb")
+    w = LMDBWriter(db)
+    rs = np.random.RandomState(0)
+    templates = rs.randint(40, 215, size=(5, 1, 12, 12))
+    for i in range(128):
+        label = int(rs.randint(0, 5))
+        arr = np.clip(templates[label]
+                      + rs.randint(-25, 25, size=(1, 12, 12)), 0, 255)
+        w.put(f"{i:08d}".encode(),
+              encode_datum(Datum(1, 12, 12,
+                                 arr.astype(np.uint8).tobytes(),
+                                 label=label)))
+    w.close()
+
+    net = tmp_path / "net.prototxt"
+    net.write_text("""
+name: "U8Net"
+layers {
+  name: "d" type: DATA top: "data" top: "label"
+  data_param { source: "%s" batch_size: 8 backend: LMDB }
+  transform_param { crop_size: 10 mirror: true scale: 0.0078125
+                    mean_value: 128 }
+}
+layers {
+  name: "ip1" type: INNER_PRODUCT bottom: "data" top: "ip1"
+  inner_product_param { num_output: 5
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } }
+}
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "ip1" bottom: "label" top: "loss" }
+""" % db)
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f"""
+net: "{net}"
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+display: 0
+max_iter: 6
+snapshot: 0
+snapshot_prefix: "snap/u8net"
+random_seed: 5
+""")
+    sp = load_solver(str(solver))
+
+    losses = {}
+    for dev_t in (False, True):
+        eng = Engine(sp, output_dir=str(tmp_path), device_transform=dev_t)
+        try:
+            if dev_t:
+                assert eng._input_transform is not None, \
+                    "device transform should engage on this config"
+                assert next(iter(eng.train_pipelines)).device_transform_spec
+            last = eng.train()
+            losses[dev_t] = float(last["loss"])
+        finally:
+            eng.close()
+    assert abs(losses[True] - losses[False]) < 1e-4, losses
