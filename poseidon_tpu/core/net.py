@@ -229,9 +229,14 @@ class Net:
         for layer in self.layers:
             lp = layer.lp
             bottoms = [blobs[b] for b in lp.bottom]
-            tops = layer.apply(
-                self._layer_params(params, layer) if layer.params else {},
-                bottoms, ctx)
+            # layer-scoped HLO metadata: xplane trace events carry the layer
+            # name, so one profiled step attributes device time per layer
+            # (no per-layer recompiles — the `time --per_layer` alternative
+            # on compile-expensive runtimes)
+            with jax.named_scope(layer.name):
+                tops = layer.apply(
+                    self._layer_params(params, layer) if layer.params else {},
+                    bottoms, ctx)
             weights = layer.loss_weights(len(tops))
             for name, val, w in zip(lp.top, tops, weights):
                 blobs[name] = val

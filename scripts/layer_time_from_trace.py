@@ -1,0 +1,139 @@
+"""Per-layer device time from ONE profiled step (the caffe-time analog for
+compile-expensive runtimes).
+
+`time --per_layer` jits every layer's forward and backward separately —
+~42 compiles for AlexNet, which times out over the tunneled backend where
+each remote compile is tens of seconds. This tool gets the same table from
+a single compile: Net.apply wraps each layer in ``jax.named_scope``, so
+every HLO instruction's metadata op_name carries its layer; we compile the
+bench train step, map instruction -> layer from the compiled module text,
+profile ONE step, and join the device-trace events against that map.
+
+Fusions spanning layers are attributed to the fusion root's layer (XLA's
+own convention for metadata); events whose instruction has no layer scope
+(optimizer update, collectives, infeed) land in "<unattributed>".
+
+Prints ONE JSON line:
+  {"metric": "layer_time_from_trace", "total_ms": N,
+   "layers": {name: {"fwd_ms": N, "bwd_ms": N}}, ...}
+
+Usage: python scripts/layer_time_from_trace.py [--model alexnet]
+       [--batch 64] [--image 227] [--classes 1000] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import tempfile
+from collections import defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=.*metadata=\{[^}]*"
+                      r"op_name=\"([^\"]*)\"")
+
+
+def instr_layer_map(hlo_text: str, layer_names) -> dict:
+    """instruction name -> (layer, is_backward) from compiled-module text."""
+    names = set(layer_names)
+    out = {}
+    for line in hlo_text.splitlines():
+        m = INSTR_RE.match(line)
+        if not m:
+            continue
+        instr, op_name = m.groups()
+        # layer names arrive wrapped by autodiff scopes — jvp(conv1),
+        # transpose(jvp(conv1)) — so match word tokens, not path segments
+        tokens = re.findall(r"[\w.\-]+", op_name)
+        layer = next((t for t in tokens if t in names), None)
+        if layer is not None:
+            out[instr] = (layer, "transpose(" in op_name)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="alexnet")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--image", type=int, default=227)
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from analyze_overlap import load_device_events, find_xplane
+    from bench import _build
+
+    payload: dict = {"metric": "layer_time_from_trace",
+                     "backend": jax.default_backend(), "model": args.model}
+    try:
+        ts, params, state, batch = _build(
+            args.model, args.batch, args.image, args.classes)
+        rng = jax.random.PRNGKey(1)
+        lowerable = ts.lowerable or ts.step
+        compiled = lowerable.lower(params, state, batch, rng).compile()
+        hlo = compiled.as_text()
+        # layer names = the net's layers; rebuild cheaply for the name list
+        from poseidon_tpu.models import zoo
+        net_param = (zoo.alexnet(num_classes=args.classes,
+                                 with_accuracy=False)
+                     if args.model == "alexnet"
+                     else zoo.googlenet(num_classes=args.classes,
+                                        with_accuracy=False))
+        layer_names = [lp.name for lp in net_param.layers]
+        imap = instr_layer_map(hlo, layer_names)
+        payload["n_attributed_instructions"] = len(imap)
+
+        # warm, then profile exactly one step
+        params, state, m = ts.step(params, state, batch, rng)
+        jax.block_until_ready(m["loss"])
+        tmp = tempfile.mkdtemp(prefix="layer_trace_")
+        jax.profiler.start_trace(tmp)
+        params, state, m = ts.step(params, state, batch, rng)
+        jax.block_until_ready(m["loss"])
+        jax.profiler.stop_trace()
+
+        planes = load_device_events(find_xplane(tmp))
+        per = defaultdict(lambda: [0.0, 0.0])
+        unattributed = 0.0
+        total = 0.0
+        for events in planes.values():
+            for name, _, dur in events:
+                base = re.sub(r"\.\d+$", "", name)
+                hit = imap.get(name) or imap.get(base)
+                # device event names sometimes carry %; strip and retry
+                if hit is None and name.startswith("%"):
+                    hit = imap.get(name[1:])
+                total += dur
+                if hit is None:
+                    unattributed += dur
+                else:
+                    layer, bwd = hit
+                    per[layer][1 if bwd else 0] += dur
+        payload["total_ms"] = round(total / 1e9, 3)
+        payload["unattributed_ms"] = round(unattributed / 1e9, 3)
+        payload["layers"] = {
+            k: {"fwd_ms": round(v[0] / 1e9, 3),
+                "bwd_ms": round(v[1] / 1e9, 3)}
+            for k, v in sorted(per.items(),
+                               key=lambda kv: -(kv[1][0] + kv[1][1]))}
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        payload["error"] = f"{type(e).__name__}: {e} | " + \
+            traceback.format_exc().strip().splitlines()[-1]
+    print(json.dumps(payload), flush=True)
+    return 0 if "error" not in payload else 1
+
+
+if __name__ == "__main__":
+    main()

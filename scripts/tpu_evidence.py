@@ -164,6 +164,16 @@ def main() -> int:
              "--steps", "3"],
             timeout=1800))
 
+    # 3b' — per-layer device time from ONE profiled step (single compile;
+    # the tunnel-friendly caffe-time analog — named_scope HLO metadata
+    # joined against the device trace)
+    if want("layer_trace"):
+        results.append(_run(
+            "layer_trace",
+            [sys.executable, "scripts/layer_time_from_trace.py",
+             "--batch", "256"],
+            timeout=1200))
+
     # 3b — per-layer fwd/bwd timing on hardware (the `caffe time` analog;
     # needs the synthetic ILSVRC12-shaped DB for real input shapes).
     # Compile-dominated over the tunnel: ~21 layers x fwd+grad jits.
