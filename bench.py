@@ -230,6 +230,12 @@ def main() -> None:
     import jax.numpy as jnp
     from poseidon_tpu import config
 
+    # POSEIDON_BENCH_PRNG=rbg swaps threefry for the TPU-cheap rbg
+    # generator (dropout mask generation rides the step's critical path)
+    prng = os.environ.get("POSEIDON_BENCH_PRNG", "")
+    if prng:
+        jax.config.update("jax_default_prng_impl", prng)
+
     # MXU-native numerics for the perf path.
     config.set_policy(compute_dtype=jnp.bfloat16)
 
@@ -249,6 +255,8 @@ def main() -> None:
 
     extras: dict = {"backend": jax.default_backend(), "device_kind": kind,
                     "n_devices": n_dev}
+    if prng:
+        extras["prng_impl"] = prng
     # extras stop once the budget is spent so the headline JSON line always
     # lands within the driver's patience, even with slow first compiles
     # (the clock started at the top of main, so probe retries count too)

@@ -508,3 +508,57 @@ random_seed: 5
         finally:
             eng.close()
     assert abs(losses[True] - losses[False]) < 1e-4, losses
+
+
+def test_engine_chunking_invariant_rng_stream(tmp_path):
+    """K must not change training: the scan body folds rng by GLOBAL
+    iteration (solver.it + offset), so a dropout net trains to identical
+    losses whether dispatched singly or in chunks."""
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.engine import Engine
+
+    net = tmp_path / "net.prototxt"
+    net.write_text("""
+name: "DropNet"
+layers {
+  name: "mnist" type: MEMORY_DATA top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 1 height: 12 width: 12 }
+}
+layers {
+  name: "ip1" type: INNER_PRODUCT bottom: "data" top: "ip1"
+  inner_product_param { num_output: 16
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } }
+}
+layers { name: "relu1" type: RELU bottom: "ip1" top: "ip1" }
+layers { name: "drop1" type: DROPOUT bottom: "ip1" top: "ip1"
+  dropout_param { dropout_ratio: 0.5 } }
+layers {
+  name: "ip2" type: INNER_PRODUCT bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 5
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } }
+}
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "ip2" bottom: "label" top: "loss" }
+""")
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f"""
+net: "{net}"
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+display: 0
+max_iter: 6
+snapshot: 0
+snapshot_prefix: "snap/dropnet"
+random_seed: 11
+""")
+    sp = load_solver(str(solver))
+    losses = {}
+    for k in (1, 3):
+        eng = Engine(sp, memory_data=_memory_data(), output_dir=str(tmp_path),
+                     steps_per_dispatch=k)
+        try:
+            last = eng.train()
+            losses[k] = float(last["loss"])
+        finally:
+            eng.close()
+    assert abs(losses[1] - losses[3]) < 5e-5, losses
