@@ -379,6 +379,7 @@ def build_ssp_train_step(
     mesh: Mesh,
     staleness: int,
     comm: Optional[CommConfig] = None,
+    input_transform: Optional[Callable] = None,
 ):
     """Staleness-s data parallelism (SSP, ssp_consistency_controller.cpp:37-161).
 
@@ -450,6 +451,8 @@ def build_ssp_train_step(
         if dcn:
             flat_idx = flat_idx + mesh.shape[axis] * lax.axis_index(dcn)
         rng = jax.random.fold_in(rng, flat_idx)
+        if input_transform is not None:
+            batch = input_transform(batch)
         squeeze = lambda tree: jax.tree_util.tree_map(lambda x: x[0], tree)
         local = squeeze(ssp.local_params)
         history = squeeze(ssp.local_history)

@@ -94,12 +94,7 @@ class Engine:
         self.memory_data = memory_data
         # uint8 ingest + on-device (x - mean) * scale (the TPU-native split
         # of DataTransformer): train pipelines ship quarter-width bytes and
-        # the normalization fuses into the compiled step. The SSP step
-        # builder has no input hook, so SSP keeps the host transform.
-        if device_transform and staleness > 0:
-            log("WARNING: device_transform not supported under SSP "
-                "staleness; keeping the host-side transform", rank=self.rank)
-            device_transform = False
+        # the normalization fuses into the compiled step (sync and SSP).
         self._device_transform = device_transform
 
         if sp.iter_size > 1:
@@ -162,7 +157,8 @@ class Engine:
             # steps, reconciling every staleness+1 iters. The engine's view
             # of "the params" is the replicated anchor (what the PS holds).
             ssp_ts = build_ssp_train_step(self.train_net, sp, self.mesh,
-                                          staleness, self.comm)
+                                          staleness, self.comm,
+                                          input_transform=self._input_transform)
             raw_step = ssp_ts.step
 
             def _ssp_step(params, state, batch, rng):

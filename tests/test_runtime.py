@@ -509,6 +509,17 @@ random_seed: 5
             eng.close()
     assert abs(losses[True] - losses[False]) < 1e-4, losses
 
+    # SSP composes too (the step builder's input hook): u8 ingest + device
+    # transform trains under staleness without error
+    eng = Engine(sp, output_dir=str(tmp_path), device_transform=True,
+                 staleness=1)
+    try:
+        assert eng._input_transform is not None
+        last = eng.train()
+        assert np.isfinite(last["loss"])
+    finally:
+        eng.close()
+
 
 def test_engine_chunking_invariant_rng_stream(tmp_path):
     """K must not change training: the scan body folds rng by GLOBAL
