@@ -222,6 +222,9 @@ class Net:
     ) -> NetOutputs:
         if train is None:
             train = self.phase == "TRAIN"
+        if comm is not None:
+            # reset the comm context's per-trace state (DWBP chain tokens)
+            getattr(comm, "begin", lambda: None)()
         ctx = ApplyCtx(train=train, rng=rng, comm=comm)
         blobs: Dict[str, jax.Array] = dict(inputs)
         loss = jnp.zeros((), jnp.float32)

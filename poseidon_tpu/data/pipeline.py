@@ -152,14 +152,16 @@ class BatchPipeline:
             # the mean by h_off/w_off), which the device cannot see — only
             # mean_value/no-mean configs move on-device
             if (self._want_device_transform and not tp.mean_file
-                    and self.native.supports_u8()):
+                    and self.native.supports_u8() and self._n_records):
                 # probe one record: float_data-backed Datums cannot ship as
                 # uint8 (rc=-4) — fall back to the host f32 path instead of
                 # crashing the prefetch worker on the first real batch
+                # (IndexError covers a DB that vanished between len() and
+                # here; the empty-DB case is excluded by _n_records above)
                 try:
                     self.native.batch_u8(np.zeros(1, np.int64))
                     self._u8 = True
-                except IOError:
+                except (IOError, IndexError):
                     self._u8 = False
             if self._u8:
                 mv = (np.asarray(tp.mean_value, np.float32)
@@ -231,16 +233,42 @@ class BatchPipeline:
             return
         stream = self._index_stream()
         batch_no = 0
+        self._warned_mixed = False
         try:
             while not self._stop.is_set():
                 idx = np.fromiter((next(stream)
                                    for _ in range(self.batch_size)),
                                   np.int64, count=self.batch_size)
                 if self.native is not None:
-                    fetch = (self.native.batch_u8 if self._u8
-                             else self.native.batch)
-                    data, labels = fetch(
-                        idx, seed=self.seed * 1_000_003 + batch_no)
+                    seed = self.seed * 1_000_003 + batch_no
+                    if self._u8:
+                        try:
+                            data, labels = self.native.batch_u8(idx, seed=seed)
+                        except IOError:
+                            # mixed byte/float DB: the init probe saw record 0
+                            # byte-backed, but THIS batch hit a float_data
+                            # Datum (rc=-4). Keep the uint8 wire contract by
+                            # undoing the host transform's (x - mean) * scale
+                            # (same seed -> same crop/mirror), instead of
+                            # killing the prefetch worker mid-epoch.
+                            data, labels = self.native.batch(idx, seed=seed)
+                            spec = self.device_transform_spec or {}
+                            raw = data / (spec.get("scale") or 1.0)
+                            mv = spec.get("mean_values")
+                            if mv is not None:
+                                raw = raw + mv.reshape(1, -1, 1, 1)
+                            data = np.clip(np.rint(raw), 0, 255) \
+                                .astype(np.uint8)
+                            if not self._warned_mixed:
+                                self._warned_mixed = True
+                                import sys
+                                print("WARNING: mixed byte/float LMDB under "
+                                      "--device_transform; float_data "
+                                      "records are re-quantized to uint8 "
+                                      "per batch (lossy for values outside "
+                                      "[0,255])", file=sys.stderr, flush=True)
+                    else:
+                        data, labels = self.native.batch(idx, seed=seed)
                 else:
                     raw = np.empty(
                         (self.batch_size,) + self.source.record_shape,

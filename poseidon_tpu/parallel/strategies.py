@@ -101,6 +101,17 @@ class CommConfig:
     # where one exists (nothing lost, only delayed — better than the
     # reference, which simply stored f16).
     wire_dtype: Optional[str] = None
+    # DWBP bucketing (solver.cpp:419-449 per-blob sync threads, recast).
+    # None (default): plain in-backward taps — XLA's all-reduce combiner may
+    # merge them into one collective (it does: round-3 dwbp_schedule.json),
+    # which is optimal when the runtime cannot overlap anyway. A number:
+    # chain the taps into ~this-many-MB buckets via ordering tokens, forcing
+    # one DISTINCT collective per bucket that issues the moment its bucket's
+    # gradients materialize mid-backward — the reference's overlap structure.
+    # 0 = one bucket per parameter (per-blob granularity, the reference's
+    # exact shape). Distinctness is a prerequisite for overlap: a combined
+    # collective can only start after the LAST gradient exists.
+    dwbp_bucket_mb: Optional[float] = None
     # Blocked top-k selection: when set, magnitude/random TOPK picks the
     # top-k within fixed-size blocks of this many elements instead of one
     # global sort — the row-granular spirit of the reference's server, which
@@ -161,6 +172,53 @@ def _sync_tap(axes: tuple, reduce: str, wire: Optional[str] = None):
 
     def bwd(_, g):
         return (wire_psum(g, axes, reduce, wire),)
+
+    tap.defvjp(fwd, bwd)
+    return tap
+
+
+@functools.lru_cache(maxsize=None)
+def _chained_sync_tap(axes: tuple, reduce: str, wire: Optional[str] = None):
+    """Sync tap with an ordering token: identity on (w, token) forward; the
+    backward psums the cotangent like ``_sync_tap`` but (a) gates the psum
+    operand on the incoming token cotangent and (b) makes the outgoing token
+    cotangent depend on the psum result.
+
+    Tokens are threaded through taps in FORWARD layer order (conv1 -> fc8),
+    so the cotangent chain runs fc8 -> conv1 — the order gradients
+    materialize in backward. Chained psums are dependency-ordered, which
+    makes it ILLEGAL for XLA's all-reduce combiner to merge them (a merge
+    would create a cycle): the compiled program keeps one distinct,
+    schedulable collective per chain stage instead of one giant fused
+    all-reduce at the end of backward. This is the fix for the round-3
+    degenerate DWBP A/B (evidence/dwbp_schedule.json: XLA merged all 18
+    per-layer taps into ONE all-reduce identical to DENSE_FUSED), restoring
+    the reference's per-layer overlap structure (solver.cpp:419-449) at
+    bucket granularity (CommConfig.dwbp_bucket_mb).
+
+    The gate is a real data dependency (``where(tok < inf, g, 0)``), not an
+    ``optimization_barrier``: barriers are stripped before XLA's all-reduce
+    combiner runs (measured on the cpu backend — the barrier-chained program
+    still compiled to ONE merged all-reduce), while a select on a runtime
+    scalar cannot be folded. The gate is the identity whenever the token is
+    finite; a non-finite token requires a non-finite psum result upstream,
+    i.e. training is already dead."""
+
+    @jax.custom_vjp
+    def tap(w, tok):
+        return w, tok
+
+    def fwd(w, tok):
+        return (w, tok), None
+
+    def bwd(_, cts):
+        g, g_tok = cts
+        gated = jnp.where(g_tok < jnp.inf, g, jnp.zeros_like(g))
+        s = wire_psum(gated, axes, reduce, wire)
+        # outgoing token depends on the psum result; its VALUE is never used
+        # numerically (only the dependency), so any finite combine works
+        g_tok_out = jnp.minimum(g_tok, s.ravel()[0].astype(g_tok.dtype))
+        return s, g_tok_out
 
     tap.defvjp(fwd, bwd)
     return tap
@@ -322,6 +380,18 @@ class CommContext:
 
     def __init__(self, cfg: CommConfig):
         self.cfg = cfg
+        self._token = None
+        self._pending: list = []
+        self._bucket_bytes = 0.0
+
+    def begin(self):
+        """Reset per-trace chain state. Net.apply calls this at entry: the
+        context is shared across traces (loss_fn is retraced by jax.grad,
+        scan bodies, debug passes), and a token tracer leaked from a
+        previous trace would poison the next one."""
+        self._token = None
+        self._pending = []
+        self._bucket_bytes = 0.0
 
     def tap_param(self, layer: str, pname: str, w: jax.Array) -> jax.Array:
         strat = self.cfg.strategy_for(layer)
@@ -331,8 +401,29 @@ class CommContext:
             # residual in TrainState.comm_error (trainer.py). DENSE_FUSED:
             # the trainer psums after the whole backward (no-overlap A/B).
             return w
-        return _sync_tap(self.cfg.sync_axes, self.cfg.reduce,
-                         self.cfg.wire_dtype)(w)
+        bucket_mb = self.cfg.dwbp_bucket_mb
+        if bucket_mb is None:
+            return _sync_tap(self.cfg.sync_axes, self.cfg.reduce,
+                             self.cfg.wire_dtype)(w)
+        # chained (bucketed DWBP) mode: close the current bucket when this
+        # param would overflow it — the next bucket's taps then chain on a
+        # token that depends on every psum in this one
+        nbytes = w.size * w.dtype.itemsize
+        if self._pending and self._bucket_bytes + nbytes > bucket_mb * 1e6:
+            tok = self._pending[0]
+            for t in self._pending[1:]:
+                tok = tok + t
+            self._token = tok
+            self._pending = []
+            self._bucket_bytes = 0.0
+        if self._token is None:
+            self._token = jnp.zeros((), jnp.float32)
+        tap = _chained_sync_tap(self.cfg.sync_axes, self.cfg.reduce,
+                                self.cfg.wire_dtype)
+        w_out, tok_out = tap(w, self._token)
+        self._pending.append(tok_out)
+        self._bucket_bytes += nbytes
+        return w_out
 
     def inner_product(self, layer: str, x, w, b) -> Optional[jax.Array]:
         if self.cfg.strategy_for(layer) != SFB:

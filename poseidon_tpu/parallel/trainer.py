@@ -97,6 +97,10 @@ class TrainStep:
     # Set when this step runs K optimizer steps per dispatch (lax.scan
     # inside the compiled program); batches then carry a leading [K] axis.
     scan_steps: Optional[int] = None
+    # Set when this step accumulates gradients over K micro-batches per
+    # optimizer step (SolverParameter.iter_size); batches carry a leading
+    # [K] micro-batch axis (inside the scan axis, when both are set).
+    iter_size: Optional[int] = None
 
 
 def comm_error_groups(comm: Optional[CommConfig], mesh: Mesh) -> int:
@@ -120,6 +124,7 @@ def build_train_step(
     scan_steps: Optional[int] = None,
     scan_reuse_batch: bool = False,
     input_transform: Optional[Callable] = None,
+    iter_size: int = 1,
 ) -> TrainStep:
     """Compiled SPMD train step over ``mesh``.
 
@@ -157,7 +162,19 @@ def build_train_step(
     ``input_transform`` runs on the batch INSIDE the compiled step (per
     scan iteration in scan mode) — the device half of the data plane's
     uint8 split (pipeline.device_transform): (x - mean) * scale fuses into
-    the first conv, and the host ships quarter-width bytes."""
+    the first conv, and the host ships quarter-width bytes.
+
+    ``iter_size=K`` (gradient accumulation — SolverParameter.iter_size, the
+    V2-prototxt surface; Caffe accumulates K batches' gradients then
+    normalizes by K in SGDSolver::Normalize): the step takes batches with a
+    leading [K] micro-batch axis and runs the forward/backward K times via
+    ``lax.scan`` (grad INSIDE the scan body, so activation memory stays at
+    one micro-batch), averages the accumulated gradients, then syncs and
+    updates ONCE. batch_size B at iter_size K is numerically equivalent to
+    batch_size B*K (tested). Per-layer comm strategies collapse to one
+    post-accumulation dense psum (there is no per-micro-batch backward
+    exchange to tap — the DWBP/SFB structures are per-step mechanisms);
+    TOPK compression still applies, on the accumulated gradient."""
     comm = comm or CommConfig()
     comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
@@ -181,6 +198,9 @@ def build_train_step(
                     if comm.strategy_for(l) == DENSE_FUSED]
     topk_fraction = budget_topk_fraction(net, comm)
     batch_spec = P(axes) if dcn else P(axis)
+    # iter_size adds an unsharded leading [K] micro-batch axis
+    step_batch_spec = (P(None, *batch_spec) if iter_size > 1
+                       else batch_spec)
     err_spec = P(dcn) if dcn else P(axis)
     for b in (dump_blobs or ()):
         if len(net.blob_shapes.get(b, ())) < 1:
@@ -189,26 +209,71 @@ def build_train_step(
                 f"needs a batch dimension (hdf5_output_layer.cpp requires "
                 f"num()-shaped bottoms)")
 
+    if iter_size > 1 and dump_blobs:
+        raise ValueError("iter_size > 1 is incompatible with dump_blobs "
+                         "(per-iteration HDF5 dump semantics)")
+
     def device_step(params, state: TrainState, batch, rng):
         flat_idx = lax.axis_index(axis)
         if dcn:
             flat_idx = flat_idx + mesh.shape[axis] * lax.axis_index(dcn)
         rng = jax.random.fold_in(rng, flat_idx)
-        if input_transform is not None:
-            batch = input_transform(batch)
 
-        def loss_fn(p):
-            out = net.apply(p, batch, train=True, rng=rng, comm=ctx,
-                            keep_blobs=bool(dump_blobs))
-            return out.loss, out
+        if iter_size > 1:
+            # gradient accumulation: grad INSIDE the scan body so only one
+            # micro-batch's activations are ever live; metrics stack [K]
+            def accum_body(acc, xs):
+                i, mb = xs
+                if input_transform is not None:
+                    mb = input_transform(mb)
 
-        grads, out = jax.grad(loss_fn, has_aux=True)(params)
-        # DENSE_FUSED: one bulk psum after the whole backward — the
-        # no-overlap baseline for the DWBP A/B.
-        for lname in fused_layers:
-            for pname, g in grads[lname].items():
-                grads[lname][pname] = wire_psum(g, axes, comm.reduce,
-                                                comm.wire_dtype)
+                def micro_loss(p):
+                    o = net.apply(p, mb, train=True,
+                                  rng=jax.random.fold_in(rng, i), comm=None)
+                    return o.loss, o
+
+                g, o = jax.grad(micro_loss, has_aux=True)(params)
+                acc = jax.tree_util.tree_map(jnp.add, acc, g)
+                m = {"loss": o.loss}
+                for name, val in o.outputs.items():
+                    if val.ndim == 0:
+                        m[name] = val.astype(jnp.float32)
+                return acc, m
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            grads, micro_ms = lax.scan(
+                accum_body, zeros, (jnp.arange(iter_size), batch))
+            # Caffe's SGDSolver::Normalize: scale accumulated grads by 1/K
+            grads = jax.tree_util.tree_map(lambda g: g / iter_size, grads)
+            out_scalars = {k: jnp.mean(v) for k, v in micro_ms.items()}
+            # one post-accumulation sync for every layer the per-backward
+            # taps would have handled (DENSE / SFB / DENSE_FUSED alike)
+            for lname in net.param_defs:
+                if comm.strategy_for(lname) not in (LOCAL, TOPK):
+                    for pname, g in grads[lname].items():
+                        grads[lname][pname] = wire_psum(
+                            g, axes, comm.reduce, comm.wire_dtype)
+            out = None
+        else:
+            if input_transform is not None:
+                batch = input_transform(batch)
+
+            def loss_fn(p):
+                o = net.apply(p, batch, train=True, rng=rng, comm=ctx,
+                              keep_blobs=bool(dump_blobs))
+                return o.loss, o
+
+            grads, out = jax.grad(loss_fn, has_aux=True)(params)
+            out_scalars = {"loss": out.loss}
+            for name, val in out.outputs.items():
+                if val.ndim == 0:
+                    out_scalars[name] = val.astype(jnp.float32)
+            # DENSE_FUSED: one bulk psum after the whole backward — the
+            # no-overlap baseline for the DWBP A/B.
+            for lname in fused_layers:
+                for pname, g in grads[lname].items():
+                    grads[lname][pname] = wire_psum(g, axes, comm.reduce,
+                                                    comm.wire_dtype)
         # Managed-comm tier: TOPK layers were left un-psummed by the tap;
         # compress the (residual-corrected) gradient, exchange only the
         # top-k entries, keep the remainder as next step's residual.
@@ -238,12 +303,10 @@ def build_train_step(
                 lerr[pname] = resid[None]
             new_errors[lname] = lerr
         new_params, new_solver = update_fn(params, grads, state.solver)
-        metrics = {"loss": lax.psum(out.loss, axes) / n_total}
-        for name, val in out.outputs.items():
-            if val.ndim == 0:
-                metrics[name] = lax.psum(val.astype(jnp.float32),
-                                         axes) / n_total
-        dumps = {b: out.blobs[b] for b in (dump_blobs or ())}
+        metrics = {name: lax.psum(val.astype(jnp.float32), axes) / n_total
+                   for name, val in out_scalars.items()}
+        dumps = ({b: out.blobs[b] for b in (dump_blobs or ())}
+                 if out is not None else {})
         return new_params, TrainState(new_solver, new_errors), metrics, dumps
 
     if scan_steps:
@@ -278,8 +341,8 @@ def build_train_step(
         # every scan iteration (per-step compute is shape-identical, params
         # still evolve through the carry) — the benchmarking mode that keeps
         # K large without K on-device batch copies.
-        scan_batch_spec = (P(*batch_spec) if scan_reuse_batch
-                           else P(None, *batch_spec))
+        scan_batch_spec = (P(*step_batch_spec) if scan_reuse_batch
+                           else P(None, *step_batch_spec))
         sharded = jax.shard_map(
             device_multi_step,
             mesh=mesh,
@@ -295,12 +358,13 @@ def build_train_step(
             replicated=NamedSharding(mesh, P()),
             lowerable=jitted,
             scan_steps=scan_steps,
+            iter_size=iter_size if iter_size > 1 else None,
         )
 
     sharded = jax.shard_map(
         device_step,
         mesh=mesh,
-        in_specs=(P(), TrainState(P(), err_spec), batch_spec, P()),
+        in_specs=(P(), TrainState(P(), err_spec), step_batch_spec, P()),
         out_specs=(P(), TrainState(P(), err_spec), P(), batch_spec),
         check_vma=False,
     )
@@ -313,22 +377,33 @@ def build_train_step(
     return TrainStep(
         step=step,
         mesh=mesh,
-        batch_sharding=NamedSharding(mesh, batch_spec),
+        batch_sharding=NamedSharding(mesh, step_batch_spec),
         replicated=NamedSharding(mesh, P()),
         lowerable=jitted,
+        iter_size=iter_size if iter_size > 1 else None,
     )
 
 
-def stack_batches(host_batches, sharding=None):
+def stack_batches(host_batches, sharding=None, lead_shape=None):
     """Stack K host batches (dicts of arrays) into one [K, ...] pytree and
     place it in ONE host->device transfer — the feeding side of
     ``scan_steps``. K transfers of one batch each would re-pay transfer
-    latency K times; one stacked transfer pays it once."""
+    latency K times; one stacked transfer pays it once. ``lead_shape``
+    reshapes the leading axis (e.g. (chunk, iter_size) when scan chunking
+    and gradient accumulation compose); under multi-process the per-host
+    stack is assembled into the global array via its sharding."""
     out = {}
+    multihost = jax.process_count() > 1
     for k in host_batches[0]:
         stacked = np.stack([np.asarray(b[k]) for b in host_batches])
-        out[k] = (jax.device_put(stacked, sharding) if sharding is not None
-                  else jnp.asarray(stacked))
+        if lead_shape is not None:
+            stacked = stacked.reshape(tuple(lead_shape) + stacked.shape[1:])
+        if sharding is None:
+            out[k] = jnp.asarray(stacked)
+        elif multihost:
+            out[k] = jax.make_array_from_process_local_data(sharding, stacked)
+        else:
+            out[k] = jax.device_put(stacked, sharding)
     return out
 
 

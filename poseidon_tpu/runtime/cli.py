@@ -43,7 +43,10 @@ def _engine_from_args(args, phase_nets=True):
                       reduce=args.grad_reduce,
                       topk_policy=getattr(args, "topk_policy", "magnitude"),
                       wire_dtype=getattr(args, "wire_dtype", None) or None,
-                      topk_block=getattr(args, "topk_block", 0) or None)
+                      topk_block=getattr(args, "topk_block", 0) or None,
+                      dwbp_bucket_mb=(
+                          None if getattr(args, "dwbp_bucket_mb", -1.0) < 0
+                          else args.dwbp_bucket_mb))
     if args.sfb_auto:
         # same config, default strategy reset (auto_strategies fills in SFB)
         comm = dataclasses.replace(comm, default_strategy="dense")
@@ -418,6 +421,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "of this many elements instead of one global sort "
                         "(row-granular, like the reference server); 0 = "
                         "global top-k")
+    t.add_argument("--dwbp_bucket_mb", type=float, default=-1.0,
+                   help="chain DWBP gradient psums into ~N-MB buckets so "
+                        "each bucket stays a DISTINCT collective issued "
+                        "mid-backward (the reference's per-blob sync-thread "
+                        "structure, solver.cpp:419-449); 0 = one per blob, "
+                        "negative = off (XLA's combiner decides)")
     t.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (MXU-native); params/updates stay "
                         "f32. Default f32 matches Caffe numerics exactly")

@@ -97,11 +97,15 @@ class Engine:
         # the normalization fuses into the compiled step (sync and SSP).
         self._device_transform = device_transform
 
-        if sp.iter_size > 1:
-            # parsed for V2-prototxt compat; the 2015 reference predates it
-            log("WARNING: iter_size > 1 (gradient accumulation) is parsed "
-                "but not applied; increase batch_size instead (per-device "
-                "semantics, docs/distributed-guide.md)", rank=self.rank)
+        # iter_size (V2-prototxt gradient accumulation; the 2015 reference
+        # predates it): K micro-batches' gradients accumulate inside the
+        # compiled step before one update — batch_size B at iter_size K is
+        # numerically equivalent to batch_size B*K (trainer.py, tested)
+        self.iter_size = max(1, int(sp.iter_size))
+        if self.iter_size > 1 and staleness > 0:
+            log("WARNING: iter_size > 1 ignored under SSP staleness "
+                "(increase batch_size instead)", rank=self.rank)
+            self.iter_size = 1
 
         train_param, test_params = resolve_nets(sp)
 
@@ -174,9 +178,15 @@ class Engine:
                 lowerable=ssp_ts.lowerable)
         else:
             dump = sorted({b for _, bs in self._h5_train for b in bs})
+            if dump and self.iter_size > 1:
+                log("WARNING: iter_size > 1 ignored with HDF5_OUTPUT in "
+                    "the TRAIN net (per-iteration dump semantics)",
+                    rank=self.rank)
+                self.iter_size = 1
             self.train_step = build_train_step(
                 self.train_net, sp, self.mesh, self.comm, dump_blobs=dump,
-                input_transform=self._input_transform)
+                input_transform=self._input_transform,
+                iter_size=self.iter_size)
 
         # --- multi-step dispatch (scan chunks) ---------------------------- #
         # K optimizer steps per compiled dispatch: amortizes the runtime's
@@ -200,7 +210,8 @@ class Engine:
                 self._scan_step = build_train_step(
                     self.train_net, sp, self.mesh, self.comm,
                     scan_steps=self.steps_per_dispatch,
-                    input_transform=self._input_transform)
+                    input_transform=self._input_transform,
+                    iter_size=self.iter_size)
         self.eval_steps = [
             build_eval_step(n, self.mesh, dcn_axis=self.comm.dcn_axis)
             for n in self.test_nets]
@@ -218,6 +229,14 @@ class Engine:
         else:
             self.state = init_train_state(self.params, self.comm,
                                           self.err_groups)
+        # single-batch placement spec (test/eval batches and non-accumulated
+        # train steps): the train step's input sharding minus the leading
+        # [iter_size] micro-batch axis it gains under gradient accumulation
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = self.train_step.batch_sharding.spec
+        if self.iter_size > 1:
+            spec = PartitionSpec(*spec[1:])
+        self._sample_sharding = NamedSharding(self.mesh, spec)
         self.metrics = MetricsTable("train")
         self.test_metrics = [MetricsTable(f"test_{i}")
                              for i in range(len(self.test_nets))]
@@ -304,7 +323,7 @@ class Engine:
 
     def _next_batch(self, pipes: List[BatchPipeline]):
         batch: Dict[str, jax.Array] = {}
-        sharding = self.train_step.batch_sharding
+        sharding = self._sample_sharding
         multihost = jax.process_count() > 1
         for pipe in pipes:
             host = next(pipe)
@@ -316,19 +335,19 @@ class Engine:
                     batch[k] = jax.device_put(v, sharding)
         return batch
 
-    def _next_batch_stack(self, pipes: List[BatchPipeline], k: int):
+    def _next_batch_stack(self, pipes: List[BatchPipeline], k: int,
+                          sharding=None, lead_shape=None):
         """k host batches stacked to [k, ...] and placed in ONE transfer
-        (the feeding side of steps_per_dispatch)."""
+        (the feeding side of steps_per_dispatch). ``lead_shape`` reshapes
+        the leading axis, e.g. (chunk, iter_size) when scan chunking and
+        gradient accumulation compose."""
         rows: List[Dict[str, np.ndarray]] = [{} for _ in range(k)]
         for pipe in pipes:
             for i in range(k):
                 rows[i].update(next(pipe))
-        sharding = self._scan_step.batch_sharding
-        if jax.process_count() > 1:
-            return {key: jax.make_array_from_process_local_data(
-                        sharding, np.stack([r[key] for r in rows]))
-                    for key in rows[0]}
-        return stack_batches(rows, sharding)
+        if sharding is None:
+            sharding = self._scan_step.batch_sharding
+        return stack_batches(rows, sharding, lead_shape=lead_shape)
 
     # ---------------------------------------------------------------- #
     def iteration(self) -> int:
@@ -474,7 +493,10 @@ class Engine:
                     chunk = self.steps_per_dispatch
 
             if chunk > 1:
-                batch = self._next_batch_stack(self.train_pipelines, chunk)
+                batch = self._next_batch_stack(
+                    self.train_pipelines, chunk * self.iter_size,
+                    lead_shape=((chunk, self.iter_size)
+                                if self.iter_size > 1 else None))
                 t0 = time.time()
                 # the scan step folds rng by global iteration internally
                 # (solver.it + offset): pass the session rng unfolded so a
@@ -484,14 +506,23 @@ class Engine:
                 it += chunk
                 at_display = bool(sp.display) and it % sp.display == 0
             else:
-                batch = self._next_batch(self.train_pipelines)
+                if self.iter_size > 1:
+                    # one optimizer step = iter_size stacked micro-batches
+                    batch = self._next_batch_stack(
+                        self.train_pipelines, self.iter_size,
+                        sharding=self.train_step.batch_sharding)
+                else:
+                    batch = self._next_batch(self.train_pipelines)
                 at_display = bool(sp.display) and (it + 1) % sp.display == 0
                 if at_display and self._debug_fn:
                     # BEFORE the step, on the step's own inputs (pre-update
                     # params, this iteration's rng/batch) — the values
                     # Caffe's ForwardDebugInfo/UpdateDebugInfo report for
-                    # iteration it+1
-                    stats = self._debug_fn(self.params, batch,
+                    # iteration it+1. Under iter_size the debug pass reads
+                    # the first micro-batch (one representative forward).
+                    dbatch = ({k: v[0] for k, v in batch.items()}
+                              if self.iter_size > 1 else batch)
+                    stats = self._debug_fn(self.params, dbatch,
                                            jax.random.fold_in(self.rng, it))
                     for key in sorted(stats):
                         kind, name = key.split("\x00")

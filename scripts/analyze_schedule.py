@@ -144,12 +144,18 @@ def build_hlo(mode: str) -> str:
     sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
     params = net.init(jax.random.PRNGKey(0))
     if mode == "dense":            # pure per-layer psums (the DWBP analog)
-        overrides = {}
+        comm = CommConfig()
     elif mode == "dense_sfb":      # the production config: SFB on the big FCs
-        overrides = {"fc6": SFB, "fc7": SFB}
+        comm = CommConfig(layer_strategies={"fc6": SFB, "fc7": SFB})
+    elif mode == "bucketed":       # chained taps: one DISTINCT collective
+        # per ~4 MB bucket, ordered fc8 -> conv1 (the round-4 fix for the
+        # degenerate A/B: the combiner cannot merge dependency-ordered psums)
+        comm = CommConfig(dwbp_bucket_mb=4.0)
+    elif mode == "per_blob":       # one collective per parameter blob — the
+        comm = CommConfig(dwbp_bucket_mb=0)   # reference's exact granularity
     else:                          # one stacked psum after the whole backward
-        overrides = {name: DENSE_FUSED for name in params}
-    comm = CommConfig(layer_strategies=overrides)
+        comm = CommConfig(layer_strategies={
+            name: DENSE_FUSED for name in params})
     ts = build_train_step(net, sp, mesh, comm, donate=False)
     state = init_train_state(params, comm, jax.device_count())
     batch = {
@@ -164,16 +170,21 @@ def build_hlo(mode: str) -> str:
 def main() -> int:
     out = {"metric": "dwbp_schedule", "n_devices": 8, "backend": "cpu-spmd"}
     try:
-        for mode in ("dense", "dense_sfb", "fused"):
+        for mode in ("dense", "dense_sfb", "bucketed", "per_blob", "fused"):
             out[mode] = analyze_module(build_hlo(mode))
-        d, f = out["dense"], out["fused"]
+        d, f, b = out["dense"], out["fused"], out["bucketed"]
         ok = (d["n_collectives"] > 0 and f["n_collectives"] > 0)
         if ok:
             out["dense_spread_vs_fused_tail"] = {
                 "dense_mean_pos": d["mean_collective_pos"],
+                "bucketed_mean_pos": b["mean_collective_pos"],
                 "fused_mean_pos": f["mean_collective_pos"],
             }
-            out["value"] = d["mean_collective_pos"]
+            # the round-3 degeneracy check, inverted into the success
+            # criterion: bucketed mode must carry MORE distinct gradient
+            # collectives than fused, spread earlier in the schedule
+            out["bucketed_distinct"] = b["n_collectives"] > f["n_collectives"]
+            out["value"] = b["mean_collective_pos"]
         else:
             out["value"] = None
             out["error"] = "no collectives found in one of the modules"

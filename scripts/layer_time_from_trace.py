@@ -105,6 +105,7 @@ def main() -> int:
 
         planes = load_device_events(find_xplane(tmp))
         per = defaultdict(lambda: [0.0, 0.0])
+        unattr_by_name = defaultdict(float)
         unattributed = 0.0
         total = 0.0
         for events in planes.values():
@@ -117,11 +118,20 @@ def main() -> int:
                 total += dur
                 if hit is None:
                     unattributed += dur
+                    unattr_by_name[base] += dur
                 else:
                     layer, bwd = hit
                     per[layer][1 if bwd else 0] += dur
         payload["total_ms"] = round(total / 1e9, 3)
         payload["unattributed_ms"] = round(unattributed / 1e9, 3)
+        # top unattributed sinks by event base name: when attribution is
+        # poor, THIS is the diagnosis (fusions without layer scope,
+        # optimizer update, infeed, runtime rows) — kept in the artifact so
+        # a bad capture still names its own gap
+        payload["top_unattributed"] = {
+            k: round(v / 1e9, 3)
+            for k, v in sorted(unattr_by_name.items(),
+                               key=lambda kv: -kv[1])[:12]}
         payload["layers"] = {
             k: {"fwd_ms": round(v[0] / 1e9, 3),
                 "bwd_ms": round(v[1] / 1e9, 3)}

@@ -426,6 +426,49 @@ def test_engine_steps_per_dispatch(tmp_path):
         eng.close()
 
 
+def test_engine_iter_size(tmp_path):
+    """iter_size (gradient accumulation, V2 surface) through the full
+    Engine: converges, and the TEST path still places its (non-stacked)
+    batches correctly — the eval-batch sharding regression a CLI drive
+    caught (train_step.batch_sharding gains a leading [iter_size] axis the
+    test batches must not inherit)."""
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.engine import Engine
+
+    solver_path = _write_mnistish_prototxt(tmp_path)
+    sp = load_solver(solver_path)
+    sp.iter_size = 2
+    eng = Engine(sp, memory_data=_memory_data(), output_dir=str(tmp_path))
+    try:
+        assert eng.iter_size == 2
+        last = eng.train()  # test_interval=15 exercises eval mid-train
+        assert last["loss"] < 0.3, f"did not converge: {last}"
+        out = eng.test(0)
+        assert out["accuracy"] > 0.9
+    finally:
+        eng.close()
+
+
+def test_engine_iter_size_composes_with_chunking(tmp_path):
+    """iter_size x steps_per_dispatch: batches stack [chunk, iter, B, ...]
+    and the cadence bookkeeping still lands exactly on max_iter."""
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.engine import Engine
+
+    solver_path = _write_mnistish_prototxt(tmp_path)
+    sp = load_solver(solver_path)
+    sp.iter_size = 2
+    eng = Engine(sp, memory_data=_memory_data(), output_dir=str(tmp_path),
+                 steps_per_dispatch=4)
+    try:
+        assert eng._scan_step is not None and eng.iter_size == 2
+        last = eng.train()
+        assert last["loss"] < 0.3, f"did not converge: {last}"
+        assert eng.iteration() == sp.max_iter
+    finally:
+        eng.close()
+
+
 def test_engine_steps_per_dispatch_ssp_falls_back(tmp_path):
     from poseidon_tpu.proto.messages import load_solver
     from poseidon_tpu.runtime.engine import Engine
