@@ -169,40 +169,63 @@ def _pool_dims(x, kernel, stride, pad):
     )
 
 
-def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine):
+def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
+                   layout: str = "NCHW"):
     """Pool by combining k_h*k_w strided slices of the padded input.
 
     Equivalent to reduce_window but built from slice+elementwise ops, which
     (unlike generic reduce_window in current JAX) differentiate cleanly inside
     shard_map; XLA fuses the slice chain back into one windowed pass.
-    """
-    n, c, h, w = x.shape
+    ``layout`` selects which axes are spatial: (2, 3) for NCHW, (1, 2) for
+    NHWC (channels-last, the TPU-preferred layout the conv path uses under
+    ``policy().conv_layout == "NHWC"``)."""
+    ah, aw = (1, 2) if layout == "NHWC" else (2, 3)
+    h, w = x.shape[ah], x.shape[aw]
     hi_h = max((oh - 1) * stride[0] + kernel[0] - pad[0] - h, 0)
     hi_w = max((ow - 1) * stride[1] + kernel[1] - pad[1] - w, 0)
-    xp = jnp.pad(x, [(0, 0), (0, 0), (pad[0], hi_h), (pad[1], hi_w)],
-                 constant_values=fill)
+    pads = [(0, 0)] * 4
+    pads[ah] = (pad[0], hi_h)
+    pads[aw] = (pad[1], hi_w)
+    xp = jnp.pad(x, pads, constant_values=fill)
     out = None
     for dh in range(kernel[0]):
         for dw in range(kernel[1]):
-            sl = lax.slice(
-                xp, (0, 0, dh, dw),
-                (n, c, dh + (oh - 1) * stride[0] + 1,
-                 dw + (ow - 1) * stride[1] + 1),
-                (1, 1, stride[0], stride[1]))
+            lo = [0, 0, 0, 0]
+            hi = list(xp.shape)
+            st = [1, 1, 1, 1]
+            lo[ah], lo[aw] = dh, dw
+            hi[ah] = dh + (oh - 1) * stride[0] + 1
+            hi[aw] = dw + (ow - 1) * stride[1] + 1
+            st[ah], st[aw] = stride
+            sl = lax.slice(xp, lo, hi, st)
             out = sl if out is None else combine(out, sl)
     return out
 
 
+def _pool_layout(x):
+    """(x_in_pool_layout, layout, restore) under the conv layout policy:
+    channels-last pooling keeps the conv->pool->conv chain free of layout
+    changes — the boundary transposes are exact inverses of the adjacent
+    convs' and cancel in XLA (the round-3 NHWC A/B lost 1.9x precisely
+    because pooling/LRN stayed NCHW and every boundary transpose survived)."""
+    if policy().conv_layout == "NHWC":
+        return (jnp.transpose(x, (0, 2, 3, 1)), "NHWC",
+                lambda y: jnp.transpose(y, (0, 3, 1, 2)))
+    return x, "NCHW", lambda y: y
+
+
 def max_pool(x, kernel, stride, pad):
     h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
-    return _window_reduce(x, kernel, stride, pad, oh, ow,
-                          -jnp.inf, jnp.maximum)
+    xt, layout, restore = _pool_layout(x)
+    return restore(_window_reduce(xt, kernel, stride, pad, oh, ow,
+                                  -jnp.inf, jnp.maximum, layout))
 
 
 def ave_pool(x, kernel, stride, pad):
     h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
-    summed = _window_reduce(x, kernel, stride, pad, oh, ow, 0.0,
-                            lambda a, b: a + b)
+    xt, layout, restore = _pool_layout(x)
+    summed = restore(_window_reduce(xt, kernel, stride, pad, oh, ow, 0.0,
+                                    lambda a, b: a + b, layout))
     # Caffe's divisor: window clipped to the padded extent [start, in+pad),
     # where start may be negative (pooling_layer.cpp:170-180). Static per
     # position, so compute host-side.
@@ -228,13 +251,15 @@ def stochastic_pool(x, kernel, stride, pad, rng, train: bool):
     h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
     if pad != (0, 0):
         raise NotImplementedError("stochastic pooling with padding")
+    xt, layout, restore = _pool_layout(x)
     add = lambda a, b: a + b
-    sum_x = _window_reduce(x, kernel, stride, pad, oh, ow, 0.0, add)
-    sum_x2 = _window_reduce(x * x, kernel, stride, pad, oh, ow, 0.0, add)
+    sum_x = _window_reduce(xt, kernel, stride, pad, oh, ow, 0.0, add, layout)
+    sum_x2 = _window_reduce(xt * xt, kernel, stride, pad, oh, ow, 0.0, add,
+                            layout)
     # Prob-weighted average in both phases (the reference's test path; exact
     # multinomial sampling at train time would break cross-replica
     # determinism).
-    return sum_x2 / jnp.maximum(sum_x, jnp.finfo(jnp.float32).tiny)
+    return restore(sum_x2 / jnp.maximum(sum_x, jnp.finfo(jnp.float32).tiny))
 
 
 # --------------------------------------------------------------------------- #
@@ -245,6 +270,18 @@ def stochastic_pool(x, kernel, stride, pad, rng, train: bool):
 def lrn_across_channels(x, local_size: int, alpha: float, beta: float, k: float = 1.0):
     pre_pad = (local_size - 1) // 2
     post_pad = local_size - pre_pad - 1
+    if policy().conv_layout == "NHWC":
+        # channel window on the minor axis, inside the same channels-last
+        # chain as the adjacent convs/pools (boundary transposes cancel)
+        xt = jnp.transpose(x, (0, 2, 3, 1))
+        n, h, w, c = xt.shape
+        sq = jnp.pad(xt * xt, [(0, 0), (0, 0), (0, 0), (pre_pad, post_pad)])
+        windowed = None
+        for dc in range(local_size):
+            sl = lax.slice(sq, (0, 0, 0, dc), (n, h, w, dc + c))
+            windowed = sl if windowed is None else windowed + sl
+        scale = k + (alpha / local_size) * windowed
+        return jnp.transpose(xt * scale ** (-beta), (0, 3, 1, 2))
     n, c, h, w = x.shape
     sq = jnp.pad(x * x, [(0, 0), (pre_pad, post_pad), (0, 0), (0, 0)])
     windowed = None

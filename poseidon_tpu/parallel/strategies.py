@@ -101,6 +101,26 @@ class CommConfig:
     # where one exists (nothing lost, only delayed — better than the
     # reference, which simply stored f16).
     wire_dtype: Optional[str] = None
+    # SSP server-side update logic (abstract_server_table_logic.hpp):
+    #   "inc"         — plain RowBatchInc: deltas add to the anchor (default)
+    #   "adarevision" — delay-corrected AdaGrad (adarevision_server_table
+    #                   _logic.cpp:52-175): the anchor update for each
+    #                   group's accumulated gradient u is
+    #                   -eta*u + (eta_old - eta)*g_bck, where g_bck is the
+    #                   gradient mass applied since that group's snapshot
+    #                   and eta = init_step/sqrt(z_max) with the revision-
+    #                   corrected accumulator z += u*(u + 2*g_bck).
+    # Only meaningful for build_ssp_train_step (the sync path has no
+    # server); composes with staleness, not with TOPK compression.
+    # NOTE: adarevision IGNORES ``reduce`` — the server applies every
+    # group's full u in sequence (the reference's RowBatchInc sum
+    # semantics; there is no mean in ApplyRowOpLog), so the effective step
+    # scales with the group count. Size ``adarev_init_step`` accordingly
+    # (~base_lr / n_groups is the stable regime — the same reason PMLS
+    # retuned lr per cluster size).
+    server_logic: str = "inc"
+    # The adarevision server's init_step_size flag (its gflags default 0.1)
+    adarev_init_step: float = 0.1
     # DWBP bucketing (solver.cpp:419-449 per-blob sync threads, recast).
     # None (default): plain in-backward taps — XLA's all-reduce combiner may
     # merge them into one collective (it does: round-3 dwbp_schedule.json),

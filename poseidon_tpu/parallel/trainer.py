@@ -440,12 +440,20 @@ class SSPState(NamedTuple):
     ``comm_error`` carries the error-feedback residual for TOPK layers whose
     *delta* exchange is compressed at sync boundaries (the SSPAggr
     composition: bounded staleness + bandwidth-managed communication,
-    ssp_aggr_bg_worker.cpp). Same stacked-per-device layout as the params."""
+    ssp_aggr_bg_worker.cpp). Same stacked-per-device layout as the params.
+
+    ``adarev_server`` / ``adarev_gsum`` exist only under
+    ``CommConfig.server_logic == "adarevision"``: the replicated server-side
+    accumulators {layer: {param: {"z", "zmax"}}} (AdaRevisionRow, init 1)
+    and each group's raw-gradient sum since its last sync (stacked per
+    group, sharded — the client's un-sent oplog)."""
     local_params: Dict   # leaves: (n_dev, *shape), sharded on axis 0
     local_history: Dict  # momentum/adagrad history, same layout
     anchor_params: Dict  # leaves: (*shape,), replicated
     it: jax.Array
     comm_error: Dict     # TOPK residuals: (n_dev, *shape), sharded on axis 0
+    adarev_server: Dict = {}  # z/zmax accumulators, replicated
+    adarev_gsum: Dict = {}    # (n_groups, *shape) raw grad sums, sharded
 
 
 def build_ssp_train_step(
@@ -513,6 +521,16 @@ def build_ssp_train_step(
                    if comm.strategy_for(l) == TOPK]
     local_layers = {l for l in net.param_defs
                     if comm.strategy_for(l) == LOCAL}
+    adarev = comm.server_logic == "adarevision"
+    if comm.server_logic not in ("inc", "adarevision"):
+        raise ValueError(f"unknown server_logic {comm.server_logic!r}")
+    if adarev and topk_layers:
+        raise ValueError(
+            "server_logic='adarevision' does not compose with TOPK delta "
+            "compression: the server logic consumes each group's RAW "
+            "accumulated gradient (adarevision_server_table_logic.cpp "
+            "applies -eta*u + (eta_old-eta)*g_bck per update), while TOPK "
+            "rewrites the delta; pick one")
     topk_fraction = budget_topk_fraction(net, comm)
     # under dcn: strategies whose gradients the in-backward taps leave raw
     # and therefore need the explicit intra-slice psum after backward
@@ -532,6 +550,7 @@ def build_ssp_train_step(
         local = squeeze(ssp.local_params)
         history = squeeze(ssp.local_history)
         error = squeeze(ssp.comm_error)
+        gsum = squeeze(ssp.adarev_gsum)
 
         def loss_fn(p):
             out = net.apply(p, batch, train=True, rng=rng, comm=ici_ctx)
@@ -544,15 +563,46 @@ def build_ssp_train_step(
                 for pname, g in grads[lname].items():
                     grads[lname][pname] = wire_psum(
                         g, (axis,), comm.reduce, comm.wire_dtype)
+        if adarev:
+            # the client-side oplog: raw gradient mass accumulated since
+            # this group's last sync (what Bösen clients send to the server)
+            gsum = {ln: {pn: gsum[ln][pn] + grads[ln][pn]
+                         for pn in grads[ln]}
+                    for ln in gsum}
         new_local, new_solver = update_fn(
             local, grads, SolverState(it=ssp.it, history=history))
 
         do_sync = (new_solver.it % period) == 0
         scale = 1.0 / n_groups if comm.reduce == "mean" else 1.0
+        eta0 = comm.adarev_init_step
+
+        def adarev_apply(av, u_local, z, zmax):
+            """The server's ApplyRowOpLog over this boundary's G arriving
+            updates, applied in group order (adarevision_server_table_
+            logic.cpp:52-175). g_bck — the gradient mass applied since the
+            sender's snapshot — is 0 at boundary start (snapshots are taken
+            at the previous boundary, when every group was sent the same
+            version) and grows by each applied update within the boundary."""
+            U = lax.all_gather(u_local, group_axis)  # (G, *shape)
+
+            def body(carry, u):
+                p, z_, zmax_, g_bck = carry
+                eta_old = eta0 / jnp.sqrt(zmax_)
+                z_ = z_ + u * (u + 2.0 * g_bck)
+                zmax_ = jnp.maximum(zmax_, z_)
+                eta = eta0 / jnp.sqrt(zmax_)
+                p = p - eta * u + (eta_old - eta) * g_bck
+                g_bck = g_bck + u
+                return (p, z_, zmax_, g_bck), None
+
+            (p_new, z_new, zmax_new, _), _ = lax.scan(
+                body, (av, z, zmax, jnp.zeros_like(av)), U)
+            return p_new, z_new, zmax_new
 
         def sync(args):
-            l, anchor, err = args
+            l, anchor, err, server, gs = args
             merged, new_anchor, new_err = {}, {}, dict(err)
+            new_server, new_gs = dict(server), dict(gs)
             for lname, lp in l.items():
                 if lname in local_layers:
                     # LOCAL blobs never cross the wire (blob.cpp LOCAL mode)
@@ -562,6 +612,19 @@ def build_ssp_train_step(
                 merged[lname], new_anchor[lname] = {}, {}
                 is_topk = lname in topk_layers
                 lerr = {}
+                if adarev:
+                    ls, lg = {}, {}
+                    for pname, lv in lp.items():
+                        m, z, zm = adarev_apply(
+                            anchor[lname][pname], gs[lname][pname],
+                            server[lname][pname]["z"],
+                            server[lname][pname]["zmax"])
+                        merged[lname][pname] = m
+                        new_anchor[lname][pname] = m
+                        ls[pname] = {"z": z, "zmax": zm}
+                        lg[pname] = jnp.zeros_like(lv)  # oplog drained
+                    new_server[lname], new_gs[lname] = ls, lg
+                    continue
                 for pname, lv in lp.items():
                     av = anchor[lname][pname]
                     delta = lv - av
@@ -582,11 +645,11 @@ def build_ssp_train_step(
                     new_anchor[lname][pname] = m
                 if is_topk:
                     new_err[lname] = lerr
-            return merged, new_anchor, new_err
+            return merged, new_anchor, new_err, new_server, new_gs
 
-        new_local, new_anchor, new_error = lax.cond(
+        new_local, new_anchor, new_error, new_server, gsum = lax.cond(
             do_sync, sync, lambda args: args,
-            (new_local, ssp.anchor_params, error))
+            (new_local, ssp.anchor_params, error, ssp.adarev_server, gsum))
         axes_all = (dcn, axis) if dcn else (axis,)
         metrics = {"loss": lax.psum(out.loss, axes_all) / n_total}
         for name, val in out.outputs.items():
@@ -595,14 +658,16 @@ def build_ssp_train_step(
                                          axes_all) / n_total
         unsq = lambda tree: jax.tree_util.tree_map(lambda x: x[None], tree)
         return SSPState(unsq(new_local), unsq(new_solver.history),
-                        new_anchor, new_solver.it, unsq(new_error)), metrics
+                        new_anchor, new_solver.it, unsq(new_error),
+                        new_server, unsq(gsum)), metrics
 
     g = group_axis
     batch_spec = P((dcn, axis)) if dcn else P(axis)
+    ssp_spec = SSPState(P(g), P(g), P(), P(), P(g), P(), P(g))
     sharded = jax.shard_map(
         device_step, mesh=mesh,
-        in_specs=(SSPState(P(g), P(g), P(), P(), P(g)), batch_spec, P()),
-        out_specs=(SSPState(P(g), P(g), P(), P(), P(g)), P()),
+        in_specs=(ssp_spec, batch_spec, P()),
+        out_specs=(ssp_spec, P()),
         check_vma=False)
     jitted = jax.jit(sharded, donate_argnums=(0,))
     return TrainStep(
@@ -614,11 +679,33 @@ def build_ssp_train_step(
     )
 
 
+def init_adarev_state(params, comm: Optional[CommConfig],
+                      n_groups: int) -> Tuple[Dict, Dict]:
+    """(adarev_server, adarev_gsum) for server_logic='adarevision':
+    z/zmax start at 1 (AdaRevisionRow ctor), gradient sums at 0."""
+    comm = comm or CommConfig()
+    if comm.server_logic != "adarevision":
+        return {}, {}
+    server = {
+        lname: {pn: {"z": jnp.ones_like(v), "zmax": jnp.ones_like(v)}
+                for pn, v in lparams.items()}
+        for lname, lparams in params.items()
+        if comm.strategy_for(lname) != LOCAL}
+    gsum = {
+        lname: {pn: jnp.zeros((n_groups,) + v.shape, v.dtype)
+                for pn, v in lparams.items()}
+        for lname, lparams in params.items()
+        if comm.strategy_for(lname) != LOCAL}
+    return server, gsum
+
+
 def init_ssp_state(params, n_dev: int,
                    comm: Optional[CommConfig] = None) -> SSPState:
     stack = lambda tree: jax.tree_util.tree_map(
         lambda x: jnp.broadcast_to(x[None], (n_dev,) + x.shape), tree)
     zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    server, gsum = init_adarev_state(params, comm, n_dev)
     return SSPState(local_params=stack(params), local_history=stack(zeros),
                     anchor_params=params, it=jnp.zeros((), jnp.int32),
-                    comm_error=init_comm_error(params, comm, n_dev))
+                    comm_error=init_comm_error(params, comm, n_dev),
+                    adarev_server=server, adarev_gsum=gsum)

@@ -102,6 +102,11 @@ def snapshot(prefix: str, net: Net, params, state) -> Tuple[str, str]:
         arrays.update({f"local_history/{k}": v
                        for k, v in
                        _flatten(gather(state.local_history)).items()})
+        arrays.update({f"adarev_server/{k}": v
+                       for k, v in _flatten(state.adarev_server).items()})
+        arrays.update({f"adarev_gsum/{k}": v
+                       for k, v in
+                       _flatten(gather(state.adarev_gsum)).items()})
     else:
         arrays["kind"] = np.asarray("dense")
         arrays.update({f"params/{k}": v for k, v in _flatten(params).items()})
@@ -139,7 +144,9 @@ def restore(state_path: str) -> Tuple[Dict, object]:
         state = SSPState(
             local_params=_unflatten(groups.get("local_params", {})),
             local_history=_unflatten(groups.get("local_history", {})),
-            anchor_params=params, it=it_arr, comm_error=err)
+            anchor_params=params, it=it_arr, comm_error=err,
+            adarev_server=_unflatten(groups.get("adarev_server", {})),
+            adarev_gsum=_unflatten(groups.get("adarev_gsum", {})))
     else:
         state = TrainState(
             solver=SolverState(it=it_arr,
@@ -164,8 +171,30 @@ def coerce_state(params, state, *, staleness: int, n_dev: int, comm=None):
     from ..solvers.updates import init_state
 
     def fix_err(p, st):
-        return st._replace(comm_error=reconcile_comm_error(
+        st = st._replace(comm_error=reconcile_comm_error(
             p, st.comm_error, comm, n_dev))
+        if not isinstance(st, SSPState):
+            return st
+        # adarevision accumulators resume only into an identically-shaped
+        # adarevision run; any config change restarts them (z/zmax at 1,
+        # empty oplog) — mixing units across server logics would inject a
+        # wrongly-scaled first sync, same reasoning as comm_error above
+        from ..parallel.trainer import init_adarev_state
+        server, gsum = init_adarev_state(p, comm, n_dev)
+        same = jax.tree_util.tree_structure(server) == \
+            jax.tree_util.tree_structure(st.adarev_server) and all(
+                a.shape == b.shape for a, b in zip(
+                    jax.tree_util.tree_leaves(server),
+                    jax.tree_util.tree_leaves(st.adarev_server)))
+        if same and server:
+            gs_same = jax.tree_util.tree_structure(gsum) == \
+                jax.tree_util.tree_structure(st.adarev_gsum) and all(
+                    a.shape == b.shape for a, b in zip(
+                        jax.tree_util.tree_leaves(gsum),
+                        jax.tree_util.tree_leaves(st.adarev_gsum)))
+            return st._replace(
+                adarev_gsum=st.adarev_gsum if gs_same else gsum)
+        return st._replace(adarev_server=server, adarev_gsum=gsum)
 
     want_ssp = staleness > 0
     is_ssp = isinstance(state, SSPState)

@@ -46,7 +46,9 @@ def _engine_from_args(args, phase_nets=True):
                       topk_block=getattr(args, "topk_block", 0) or None,
                       dwbp_bucket_mb=(
                           None if getattr(args, "dwbp_bucket_mb", -1.0) < 0
-                          else args.dwbp_bucket_mb))
+                          else args.dwbp_bucket_mb),
+                      server_logic=getattr(args, "server_logic", "inc"),
+                      adarev_init_step=getattr(args, "adarev_init_step", 0.1))
     if args.sfb_auto:
         # same config, default strategy reset (auto_strategies fills in SFB)
         comm = dataclasses.replace(comm, default_strategy="dense")
@@ -438,6 +440,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="SSP bound s: devices run local steps, reconciling "
                         "every s+1 iters (0 = synchronous, the reference's "
                         "recommended setting)")
+    t.add_argument("--server_logic", default="inc",
+                   choices=["inc", "adarevision"],
+                   help="SSP anchor update rule: plain delta increment "
+                        "(inc) or delay-corrected AdaGrad (the server's "
+                        "adarevision_server_table_logic); needs --staleness")
+    t.add_argument("--adarev_init_step", type=float, default=0.1,
+                   help="adarevision server init_step_size; scales the SUM "
+                        "of group updates (reduce is ignored — the server "
+                        "applies every group's full update, the reference's "
+                        "RowBatchInc semantics), so ~base_lr/n_groups is "
+                        "the stable regime")
     t.add_argument("--hostfile", default="",
                    help="cluster hostfile ('<id> <ip> <port>' lines)")
     t.add_argument("--node_id", type=int, default=-1,

@@ -97,6 +97,11 @@ class Engine:
         # the normalization fuses into the compiled step (sync and SSP).
         self._device_transform = device_transform
 
+        if self.comm.server_logic != "inc" and staleness == 0:
+            log(f"WARNING: --server_logic {self.comm.server_logic} requires "
+                f"--staleness > 0 (there is no server in the synchronous "
+                f"step); training plain sync SGD", rank=self.rank)
+
         # iter_size (V2-prototxt gradient accumulation; the 2015 reference
         # predates it): K micro-batches' gradients accumulate inside the
         # compiled step before one update — batch_size B at iter_size K is

@@ -73,6 +73,41 @@ def test_lrn_across_channels(rng_np):
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
+def test_pool_lrn_nhwc_layout_matches_nchw(rng_np):
+    """Channels-last pooling/LRN (round-4: the whole conv->lrn->pool chain
+    runs NHWC under the policy, so boundary transposes cancel — round 3
+    left pool/LRN NCHW and every transpose survived, the 1.9x anomaly):
+    identical numbers either way, forward and backward."""
+    import jax
+    from poseidon_tpu.config import policy_scope
+    x = rng_np.randn(2, 8, 9, 9).astype(np.float32)
+
+    def run():
+        outs = {
+            "max": NN.max_pool(x, (3, 3), (2, 2), (1, 1)),
+            "ave": NN.ave_pool(x, (3, 3), (2, 2), (1, 1)),
+            "lrn": NN.lrn_across_channels(x, 5, 1e-4, 0.75),
+            "lrn_w": NN.lrn_within_channel(x, 3, 1e-4, 0.75),
+        }
+        grads = {
+            k: jax.grad(lambda xx, _f=f: _f(xx).sum())(x)
+            for k, f in {
+                "max": lambda xx: NN.max_pool(xx, (3, 3), (2, 2), (1, 1)),
+                "lrn": lambda xx: NN.lrn_across_channels(xx, 5, 1e-4, 0.75),
+            }.items()}
+        return outs, grads
+
+    o1, g1 = run()
+    with policy_scope(conv_layout="NHWC"):
+        o2, g2 = run()
+    for k in o1:
+        np.testing.assert_allclose(np.asarray(o1[k]), np.asarray(o2[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=f"grad:{k}")
+
+
 def test_lrn_within_channel(rng_np):
     x = rng_np.randn(2, 3, 7, 7).astype(np.float32)
     got = np.asarray(NN.lrn_within_channel(x, 3, 5e-5, 0.75))
