@@ -171,3 +171,168 @@ def build_dp_sp_train_step(cfg: TransformerConfig, sp: SolverParameter,
         out_specs=(P(), P(), P()),
         check_vma=False)
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+
+# --------------------------------------------------------------------------- #
+# Tensor parallelism (Megatron-style): dp x tp over a ("data", "model") mesh
+# --------------------------------------------------------------------------- #
+
+
+def to_tp_layout(params: Dict, cfg: TransformerConfig) -> Dict:
+    """Rearrange each block's fused qkv weight from [q-heads; k-heads;
+    v-heads] row order to HEAD-major [(q,k,v) of head 0; (q,k,v) of head 1;
+    ...]: a contiguous row split over the "model" axis then gives every
+    rank the full q/k/v of its own heads (the Megatron column-parallel
+    layout). All other leaves are unchanged; ``from_tp_layout`` inverts."""
+    dh = cfg.d_model // cfg.n_heads
+    out = {k: dict(v) for k, v in params.items()}
+    for lname, lp in out.items():
+        if lname.startswith("block"):
+            w = lp["wqkv"].reshape(3, cfg.n_heads, dh, cfg.d_model)
+            lp["wqkv"] = jnp.transpose(w, (1, 0, 2, 3)).reshape(
+                3 * cfg.d_model, cfg.d_model)
+    return out
+
+
+def from_tp_layout(params: Dict, cfg: TransformerConfig) -> Dict:
+    dh = cfg.d_model // cfg.n_heads
+    out = {k: dict(v) for k, v in params.items()}
+    for lname, lp in out.items():
+        if lname.startswith("block"):
+            w = lp["wqkv"].reshape(cfg.n_heads, 3, dh, cfg.d_model)
+            lp["wqkv"] = jnp.transpose(w, (1, 0, 2, 3)).reshape(
+                3 * cfg.d_model, cfg.d_model)
+    return out
+
+
+def tp_param_specs(params: Dict, tp_axis: str = "model") -> Dict:
+    """PartitionSpec pytree mirroring ``params`` (in TP layout): attention
+    qkv and FFN w1 column-split, wo and w2 row-split, everything else
+    (embedding, positions, head, layer norms) replicated."""
+    specs: Dict = {}
+    for lname, lp in params.items():
+        if lname.startswith("block"):
+            specs[lname] = {
+                "wqkv": P(tp_axis, None),   # head-major rows (to_tp_layout)
+                "wo": P(None, tp_axis),     # input dim is head-major
+                "w1": P(tp_axis, None),
+                "w2": P(None, tp_axis),
+                "ln1_g": P(), "ln1_b": P(), "ln2_g": P(), "ln2_b": P(),
+            }
+        else:
+            specs[lname] = {k: P() for k in lp}
+    return specs
+
+
+def build_dp_tp_train_step(cfg: TransformerConfig, sp: SolverParameter,
+                           mesh: Mesh, params: Dict,
+                           data_axis: str = "data",
+                           tp_axis: str = "model", donate: bool = True):
+    """Training step over a 2-D (data x model) mesh — Megatron-style tensor
+    parallelism built on XLA collectives instead of hand-written NCCL
+    groups (the reference's distributed substrate, SURVEY §2.3; TP itself
+    is beyond the 2015 reference, first-class here per the long-context /
+    distributed mandate).
+
+    Per block, each tp rank holds n_heads/T full (q,k,v) head slices
+    (column-parallel wqkv in head-major layout — ``to_tp_layout``), runs
+    attention on its own heads, and contributes a partial output through
+    its wo row shard; one psum over ``tp_axis`` restores the replicated
+    residual stream. The FFN splits the same way (w1 columns, w2 rows, one
+    psum). Embedding/positions/head/layer-norms stay replicated; the
+    residual stream is replicated on every rank, so the loss is too.
+
+    Gradient flow uses Megatron's f/g conjugate operators: ``g`` is the
+    forward psum after each row-parallel matmul (its autodiff backward is
+    the identity — every rank receives the full cotangent), and ``f`` is
+    an identity-forward / psum-backward custom_vjp at each column-parallel
+    region's INPUT, so the cotangent reaching the replicated residual
+    stream is the full sum over ranks, not a per-rank partial. With both
+    in place every replicated leaf's gradient is bit-identical on all tp
+    ranks (no post-hoc psum — a naive one double-counts the residual-path
+    contributions, which are computed in full on every rank), and each
+    sharded leaf's gradient is complete locally. Everything then pmeans
+    over ``data_axis``. Pass params through ``to_tp_layout`` first
+    (``params`` is used for the spec pytree only — the step still takes
+    params positionally); the sharding is published via
+    ``tp_param_specs``."""
+    specs = tp_param_specs(params, tp_axis)
+
+    @jax.custom_vjp
+    def f_op(x):
+        return x
+
+    def _f_fwd(x):
+        return x, None
+
+    def _f_bwd(_, g):
+        return (lax.psum(g, tp_axis),)
+
+    f_op.defvjp(_f_fwd, _f_bwd)
+
+    @jax.custom_vjp
+    def g_op(x):
+        return lax.psum(x, tp_axis)
+
+    def _g_fwd(x):
+        return lax.psum(x, tp_axis), None
+
+    def _g_bwd(_, ct):
+        # the conjugate of f: psum forward, IDENTITY backward — a raw
+        # lax.psum must not sit in the differentiated path because its
+        # autodiff transpose is another psum, which multiplies an
+        # already-replicated cotangent by the rank count (measured: 4x per
+        # crossed psum on a 4-way tp mesh)
+        return (ct,)
+
+    g_op.defvjp(_g_fwd, _g_bwd)
+
+    def block_tp(x, blk):
+        b, s, _ = x.shape
+        dh = cfg.d_model // cfg.n_heads
+        h = f_op(_layer_norm(x, blk["ln1_g"], blk["ln1_b"]))
+        qkv = _dense(h, blk["wqkv"])          # (B, S, Hl*3*dh)
+        hl = qkv.shape[-1] // (3 * dh)        # local heads on this rank
+        qkv = qkv.reshape(b, s, hl, 3, dh)
+        q, k, v = (qkv[:, :, :, j].swapaxes(1, 2) for j in range(3))
+        att = maybe_flash_attention(q, k, v, causal=True)
+        att = att.swapaxes(1, 2).reshape(b, s, hl * dh)
+        # row-parallel wo: partial product, summed across ranks
+        part = _dense(att, blk["wo"])
+        x = x + g_op(part).astype(x.dtype)
+        h = f_op(_layer_norm(x, blk["ln2_g"], blk["ln2_b"]))
+        ff_part = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
+        return x + g_op(ff_part).astype(x.dtype)
+
+    def forward_tp(p, tokens):
+        b, s = tokens.shape
+        x = p["embed"]["w"][tokens]
+        x = x + p["pos"]["w"][jnp.arange(s)]
+        blk_fn = jax.checkpoint(block_tp) if cfg.remat else block_tp
+        for i in range(cfg.n_layers):
+            x = blk_fn(x, p[f"block{i}"])
+        x = _layer_norm(x, p["ln_f"]["g"], p["ln_f"]["b"])
+        return _dense(x, p["head"]["w"]).astype(jnp.float32)
+
+    def device_step(p, state: SolverState, tokens, targets, rng):
+        def loss_fn(pp):
+            return lm_loss(forward_tp(pp, tokens), targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        # replicated leaves' grads are already full on every tp rank (the
+        # f/g operators did the cross-rank sums in backward); sharded
+        # leaves' grads are complete locally — only the data axis remains
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, data_axis), grads)
+        upd = make_update_fn(sp, transformer_mults(p))
+        new_params, new_state = upd(p, grads, state)
+        metrics = {"loss": lax.pmean(loss, data_axis)}
+        return new_params, new_state, metrics
+
+    state_spec = SolverState(it=P(), history=specs)
+    sharded = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(specs, state_spec, P(data_axis), P(data_axis), P()),
+        out_specs=(specs, state_spec, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
