@@ -89,6 +89,31 @@ def _dense(x, w):
         precision=matmul_precision())
 
 
+def block_forward(cfg: TransformerConfig, x: jax.Array, blk: Dict,
+                  *, seq_axis: Optional[str] = None) -> jax.Array:
+    """One decoder block: ln1 -> fused qkv -> (flash | ring) attention ->
+    wo residual -> ln2 -> gelu FFN residual. The single definition of the
+    block math — forward() and the pipeline path both call it (the tp path
+    differs structurally via its f/g collectives)."""
+    b, s, _ = x.shape
+    h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
+    qkv = _dense(h, blk["wqkv"])  # (B, S, 3*D)
+    d_head = cfg.d_model // cfg.n_heads
+    qkv = qkv.reshape(b, s, 3, cfg.n_heads, d_head)
+    q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))  # (B,H,S,Dh)
+    if seq_axis is None:
+        # Pallas flash kernel when the sequence tiles cleanly (O(S)
+        # memory, never materializes S x S scores in HBM)
+        att = maybe_flash_attention(q, k, v, causal=True)
+    else:
+        att = ring_attention(q, k, v, seq_axis, causal=True)
+    att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)
+    x = x + _dense(att, blk["wo"]).astype(x.dtype)
+    h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+    ff = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
+    return x + ff.astype(x.dtype)
+
+
 def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
             *, seq_axis: Optional[str] = None,
             pos_offset: jax.Array | int = 0) -> jax.Array:
@@ -98,23 +123,9 @@ def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
     x = params["embed"]["w"][tokens]
     positions = pos_offset + jnp.arange(s)
     x = x + params["pos"]["w"][positions]
+
     def block(x, blk):
-        h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
-        qkv = _dense(h, blk["wqkv"])  # (B, S, 3*D)
-        d_head = cfg.d_model // cfg.n_heads
-        qkv = qkv.reshape(b, s, 3, cfg.n_heads, d_head)
-        q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))  # (B,H,S,Dh)
-        if seq_axis is None:
-            # Pallas flash kernel when the sequence tiles cleanly (O(S)
-            # memory, never materializes S x S scores in HBM)
-            att = maybe_flash_attention(q, k, v, causal=True)
-        else:
-            att = ring_attention(q, k, v, seq_axis, causal=True)
-        att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)
-        x = x + _dense(att, blk["wo"]).astype(x.dtype)
-        h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
-        ff = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
-        return x + ff.astype(x.dtype)
+        return block_forward(cfg, x, blk, seq_axis=seq_axis)
 
     if cfg.remat:
         # policy: keep only each block's input; everything inside (scores,
@@ -327,6 +338,152 @@ def build_dp_tp_train_step(cfg: TransformerConfig, sp: SolverParameter,
         upd = make_update_fn(sp, transformer_mults(p))
         new_params, new_state = upd(p, grads, state)
         metrics = {"loss": lax.pmean(loss, data_axis)}
+        return new_params, new_state, metrics
+
+    state_spec = SolverState(it=P(), history=specs)
+    sharded = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(specs, state_spec, P(data_axis), P(data_axis), P()),
+        out_specs=(specs, state_spec, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+
+# --------------------------------------------------------------------------- #
+# Pipeline parallelism (GPipe-style): dp x pp over a ("data", "stage") mesh
+# --------------------------------------------------------------------------- #
+
+
+def to_pp_layout(params: Dict, cfg: TransformerConfig) -> Dict:
+    """Stack the per-block leaves along a leading layer axis so a contiguous
+    split over the "stage" mesh axis gives each stage its run of layers:
+    ``{"block0": {...}, "block1": {...}}`` becomes ``{"blocks": {leaf:
+    [n_layers, ...]}}``. Embed/pos/head/ln_f pass through (replicated; only
+    the first/last stage's copies carry gradient). ``from_pp_layout``
+    inverts."""
+    out = {k: dict(v) for k, v in params.items() if not k.startswith("block")}
+    names = sorted((k for k in params if k.startswith("block")),
+                   key=lambda k: int(k[len("block"):]))
+    out["blocks"] = {
+        leaf: jnp.stack([params[n][leaf] for n in names])
+        for leaf in params[names[0]]}
+    return out
+
+
+def from_pp_layout(params: Dict, cfg: TransformerConfig) -> Dict:
+    out = {k: dict(v) for k, v in params.items() if k != "blocks"}
+    n_layers = next(iter(params["blocks"].values())).shape[0]
+    for i in range(n_layers):
+        out[f"block{i}"] = {leaf: v[i] for leaf, v in params["blocks"].items()}
+    return out
+
+
+def pp_param_specs(params: Dict, stage_axis: str = "stage") -> Dict:
+    """PartitionSpec pytree for the PP layout: stacked block leaves split on
+    the layer axis over ``stage_axis``, everything else replicated."""
+    return {lname: {leaf: (P(stage_axis) if lname == "blocks" else P())
+                    for leaf in lp}
+            for lname, lp in params.items()}
+
+
+def build_dp_pp_train_step(cfg: TransformerConfig, sp: SolverParameter,
+                           mesh: Mesh, params: Dict, microbatches: int,
+                           data_axis: str = "data",
+                           stage_axis: str = "stage", donate: bool = True):
+    """Training step over a 2-D (data x stage) mesh — GPipe-style pipeline
+    parallelism as ONE differentiable compiled program, not a scheduler.
+    Where a CUDA framework hand-writes a 1F1B schedule with per-stage
+    threads and NCCL send/recv (the reference's per-layer comm threads are
+    the closest analog, solver.cpp's DWBP), here the forward schedule is a
+    ``lax.scan`` over microbatch ticks with a ``ppermute`` ring shifting
+    activations stage->stage+1, and the BACKWARD pipeline falls out of
+    autodiff: the transpose of the scan runs the ticks in reverse and the
+    transpose of each ppermute is the reverse rotation, so the cotangents
+    ride the ring backwards with no scheduler code at all.
+
+    Layers split contiguously over ``stage_axis`` (stacked leaves,
+    ``to_pp_layout``); each stage scans over its local run. The local batch
+    splits into ``microbatches`` microbatches; tick t ingests microbatch t
+    at stage 0 (embedding) and retires one at the last stage (final LN +
+    head + summed token loss) once the pipe fills. SPMD means every stage
+    executes the ingest/egress code with masked selects — the embed/head
+    FLOPs are spent on every stage but only stage 0 / stage S-1 keep the
+    result, the standard SPMD-pipeline trade. Activation memory is GPipe's
+    (all live ticks), cut by per-tick remat when ``cfg.remat``.
+
+    Gradients: block grads are stage-local by construction (cotangents
+    arrive over the reversed ring); the masked selects zero every other
+    stage's embed/head/ln_f grads, so one explicit psum over ``stage_axis``
+    (outside the differentiated region — a raw psum inside it transposes to
+    another psum and over-counts) restores the replicated leaves, then
+    everything pmeans over ``data_axis``. The per-device loss scalar stays
+    un-psum'd inside ``loss_fn`` for the same reason; the metric sums
+    across stages afterwards. Requires n_layers % n_stages == 0 and
+    local batch % microbatches == 0."""
+    n_stage = dict(zip(mesh.axis_names, mesh.devices.shape))[stage_axis]
+    n_layers = next(iter(params["blocks"].values())).shape[0]
+    if n_layers % n_stage:
+        raise ValueError(f"n_layers={n_layers} not divisible by "
+                         f"{n_stage} pipeline stages")
+    specs = pp_param_specs(params, stage_axis)
+
+    def device_step(p, state: SolverState, tokens, targets, rng):
+        stage = lax.axis_index(stage_axis)
+        b_local, s_len = tokens.shape
+        m = microbatches
+        if b_local % m:
+            raise ValueError(f"local batch {b_local} not divisible by "
+                             f"{m} microbatches")
+        bm = b_local // m
+        tok_mb = tokens.reshape(m, bm, s_len)
+        tgt_mb = targets.reshape(m, bm, s_len)
+        n_tokens = float(m * bm * s_len)
+        perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+        def tick(pp, x, t):
+            # ingest (kept by stage 0 only): embed microbatch t
+            toks = lax.dynamic_index_in_dim(
+                tok_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            fresh = (pp["embed"]["w"][toks]
+                     + pp["pos"]["w"][jnp.arange(s_len)])
+            x = jnp.where(stage == 0, fresh, x)
+            # this stage's run of layers
+            def body(h, blk):
+                return block_forward(cfg, h, blk), None
+            x, _ = lax.scan(body, x, pp["blocks"])
+            # egress (kept by the last stage once the pipe is full):
+            # microbatch t - (n_stage - 1) retires at tick t
+            out_idx = t - (n_stage - 1)
+            h = _layer_norm(x, pp["ln_f"]["g"], pp["ln_f"]["b"])
+            logits = _dense(h, pp["head"]["w"]).astype(jnp.float32)
+            tgt = lax.dynamic_index_in_dim(
+                tgt_mb, jnp.clip(out_idx, 0, m - 1), 0, keepdims=False)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            valid = (out_idx >= 0) & (stage == n_stage - 1)
+            loss = jnp.where(valid, -jnp.sum(picked) / n_tokens, 0.0)
+            return lax.ppermute(x, stage_axis, perm), loss
+
+        tick_fn = jax.checkpoint(tick) if cfg.remat else tick
+
+        def loss_fn(pp):
+            def tick_p(x, t):
+                return tick_fn(pp, x, t)
+            x0 = jnp.zeros((bm, s_len, cfg.d_model), jnp.float32)
+            _, losses = lax.scan(tick_p, x0, jnp.arange(m + n_stage - 1))
+            # per-device scalar: zero except on the last stage (see above)
+            return jnp.sum(losses)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        grads = {lname: {leaf: (g if lname == "blocks"
+                                else lax.psum(g, stage_axis))
+                         for leaf, g in lg.items()}
+                 for lname, lg in grads.items()}
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, data_axis), grads)
+        upd = make_update_fn(sp, transformer_mults(p))
+        new_params, new_state = upd(p, grads, state)
+        metrics = {"loss": lax.pmean(lax.psum(loss, stage_axis), data_axis)}
         return new_params, new_state, metrics
 
     state_spec = SolverState(it=P(), history=specs)
