@@ -1,0 +1,247 @@
+"""Mixture-of-experts transformer — expert parallelism over an "expert" axis.
+
+Completes the framework's parallelism set (dp/sp/tp/pp/ep). The reference's
+distributed substrate is a parameter server moving dense gradients
+(SURVEY §2.2); expert parallelism has no 2015 analog — it exists here because
+the mandate makes large-scale distributed training first-class. The design is
+the standard TPU MoE recipe (Switch/GShard): top-1 routing with a fixed
+per-source capacity so every shape is static, dispatch/combine as einsums
+against a one-hot dispatch tensor, and ONE pair of `lax.all_to_all`
+collectives per MoE layer to move tokens to their experts and back. Token
+dropping (over-capacity) is a masked select, not control flow — XLA sees a
+fixed program.
+
+Gradient flow: the router learns through the gate probability that scales
+each expert's output (straight-through top-1, Switch §2.2 of the paper
+family); dropped tokens pass through the residual only. The all_to_all
+transpose routes expert-weight cotangents back to the owning rank, so expert
+grads arrive summed over the expert-axis group with no explicit collective;
+replicated-leaf grads need the usual psum (done OUTSIDE the differentiated
+region — see build_dp_tp_train_step's note on psum transposition).
+
+Losses are normalized by the STATIC global token count so the cross-device
+reduction is a plain psum (exact, order-independent)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..proto.messages import SolverParameter
+from ..solvers.updates import SolverState, make_update_fn
+from .transformer import (TransformerConfig, _dense, _layer_norm,
+                          attention_sublayer, embed_tokens, lm_head,
+                          transformer_mults)
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    base: TransformerConfig
+    n_experts: int = 8
+    # tokens each SOURCE shard may send to each expert; 0 = auto from
+    # capacity_factor (even-load tokens * factor, rounded up)
+    capacity: int = 0
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+
+
+def resolved_capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    if cfg.capacity:
+        return cfg.capacity
+    return int(np.ceil(n_tokens / cfg.n_experts * cfg.capacity_factor))
+
+
+def init_moe_params(cfg: MoEConfig, rng: jax.Array) -> Dict:
+    """Like transformer.init_params but each block's dense FFN is replaced
+    by a router ``wg`` (E, D) and per-expert stacks ``w1e`` (E, F, D) /
+    ``w2e`` (E, D, F); the leading E axis is what shards over "expert"."""
+    b = cfg.base
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in)))
+
+    keys = jax.random.split(rng, 4 + 8 * b.n_layers)
+    params: Dict = {
+        "embed": {"w": dense(keys[0], 1, (b.vocab_size, b.d_model)) * 0.02},
+        "pos": {"w": dense(keys[1], 1, (b.max_seq, b.d_model)) * 0.02},
+        "head": {"w": dense(keys[2], b.d_model, (b.vocab_size, b.d_model))},
+        "ln_f": {"g": jnp.ones((b.d_model,)), "b": jnp.zeros((b.d_model,))},
+    }
+    for i in range(b.n_layers):
+        k = keys[4 + 8 * i:4 + 8 * (i + 1)]
+        params[f"block{i}"] = {
+            "wqkv": dense(k[0], b.d_model, (3 * b.d_model, b.d_model)),
+            "wo": dense(k[1], b.d_model, (b.d_model, b.d_model)),
+            "wg": dense(k[2], b.d_model, (cfg.n_experts, b.d_model)),
+            "w1e": dense(k[3], b.d_model,
+                         (cfg.n_experts, b.d_ff, b.d_model)),
+            "w2e": dense(k[4], b.d_ff, (cfg.n_experts, b.d_model, b.d_ff)),
+            "ln1_g": jnp.ones((b.d_model,)),
+            "ln1_b": jnp.zeros((b.d_model,)),
+            "ln2_g": jnp.ones((b.d_model,)),
+            "ln2_b": jnp.zeros((b.d_model,)),
+        }
+    return params
+
+
+def _experts_apply(w1e, w2e, toks):
+    """toks (E_local, N, D) through each local expert's gelu FFN."""
+    def one(w1, w2, t):
+        return _dense(jax.nn.gelu(_dense(t, w1)), w2)
+    return jax.vmap(one)(w1e, w2e, toks)
+
+
+def moe_ffn(x: jax.Array, wg: jax.Array, w1e: jax.Array, w2e: jax.Array,
+            cfg: MoEConfig, *, expert_axis: Optional[str] = None,
+            n_expert_ranks: int = 1) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 switch FFN over flat tokens x (T, D) -> (y (T, D), aux loss).
+
+    With ``expert_axis``, ``w1e``/``w2e`` hold only this rank's
+    E/n_expert_ranks experts and tokens move over the mesh: dispatch einsum
+    -> all_to_all (tokens to owning rank) -> local expert FFNs ->
+    all_to_all back -> combine einsum. Without it, all experts are local
+    and the same code skips the exchange — the single-device reference the
+    parity test checks against."""
+    t_local, d = x.shape
+    n_exp = cfg.n_experts
+    cap = resolved_capacity(cfg, t_local)
+
+    logits = _dense(x, wg).astype(jnp.float32)      # (T, E)
+    gates = jax.nn.softmax(logits, axis=-1)
+    e_star = jnp.argmax(gates, axis=-1)             # (T,)
+    gate = jnp.take_along_axis(gates, e_star[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(e_star, n_exp, dtype=jnp.float32)
+    # position of each token in its expert's queue; beyond-capacity drops
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    keep = (pos >= 0) & (pos < cap)                 # (T, E)
+    slot = jax.nn.one_hot(jnp.clip(pos, 0, cap - 1).astype(jnp.int32),
+                          cap, dtype=jnp.float32)   # (T, E, C)
+    disp = slot * keep[..., None]                   # 0/1 dispatch tensor
+    comb = disp * gate[:, None, None]               # gate-weighted combine
+
+    xd = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)  # (E, C, D)
+    if expert_axis is not None:
+        e_local = n_exp // n_expert_ranks
+        xd = xd.reshape(n_expert_ranks, e_local, cap, d)
+        # rank r keeps its expert slice from every source rank; after the
+        # exchange axis 0 indexes the SOURCE rank
+        xd = lax.all_to_all(xd, expert_axis, split_axis=0, concat_axis=0)
+        toks = xd.transpose(1, 0, 2, 3).reshape(e_local,
+                                                n_expert_ranks * cap, d)
+        out = _experts_apply(w1e, w2e, toks).astype(x.dtype)
+        out = out.reshape(e_local, n_expert_ranks, cap, d) \
+            .transpose(1, 0, 2, 3)
+        out = lax.all_to_all(out, expert_axis, split_axis=0, concat_axis=0)
+        out = out.reshape(n_exp, cap, d)
+    else:
+        out = _experts_apply(w1e, w2e, xd).astype(x.dtype)
+    y = jnp.einsum("tec,ecd->td", comb.astype(x.dtype), out)
+
+    # Switch load-balancing loss: n_exp * sum_e fraction_e * mean_gate_e
+    frac = jnp.mean(onehot, axis=0)
+    mean_gate = jnp.mean(gates, axis=0)
+    aux = cfg.aux_weight * n_exp * jnp.sum(frac * mean_gate)
+    return y, aux
+
+
+def moe_forward(params: Dict, cfg: MoEConfig, tokens: jax.Array,
+                *, expert_axis: Optional[str] = None,
+                n_expert_ranks: int = 1) -> Tuple[jax.Array, jax.Array]:
+    """tokens (B, S) -> (logits (B, S, V), summed aux loss). Entry/exit
+    scaffold (embed/pos, final ln + head) is shared with the dense model;
+    ``cfg.base.remat`` checkpoints each MoE block like every other path."""
+    b_sz, s = tokens.shape
+    bcfg = cfg.base
+    x = embed_tokens(params, tokens)
+
+    def moe_block(x, blk):
+        x = attention_sublayer(bcfg, x, blk)
+        h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+        y, aux = moe_ffn(h.reshape(b_sz * s, bcfg.d_model), blk["wg"],
+                         blk["w1e"], blk["w2e"], cfg,
+                         expert_axis=expert_axis,
+                         n_expert_ranks=n_expert_ranks)
+        return x + y.reshape(b_sz, s, bcfg.d_model).astype(x.dtype), aux
+
+    if bcfg.remat:
+        # drop the dispatch/combine tensors (O(T x E x C)) and attention
+        # internals from the stored residuals, like the dense paths do
+        moe_block = jax.checkpoint(moe_block)
+    aux_total = jnp.zeros((), jnp.float32)
+    for i in range(bcfg.n_layers):
+        x, aux = moe_block(x, params[f"block{i}"])
+        aux_total = aux_total + aux
+    return lm_head(params, x), aux_total
+
+
+def ep_param_specs(params: Dict, expert_axis: str = "expert") -> Dict:
+    """Expert stacks split on their leading E axis; everything else
+    (attention, router, embeddings, head, norms) replicated."""
+    return {lname: {leaf: (P(expert_axis) if leaf in ("w1e", "w2e")
+                           else P())
+                    for leaf in lp}
+            for lname, lp in params.items()}
+
+
+def build_dp_ep_train_step(cfg: MoEConfig, sp: SolverParameter, mesh: Mesh,
+                           params: Dict, data_axis: str = "data",
+                           expert_axis: str = "expert",
+                           donate: bool = True):
+    """Training step over a 2-D (data x expert) mesh. The batch shards over
+    BOTH axes (every device works distinct tokens); expert stacks shard
+    over ``expert_axis``; each MoE layer runs one all_to_all out and one
+    back within the expert-axis group.
+
+    Losses are local-sum / STATIC global token count, so: replicated-leaf
+    grads psum over both axes; expert-leaf grads arrive already summed over
+    the expert group (all_to_all transpose) and psum over ``data_axis``
+    only. Both psums sit outside the differentiated region."""
+    n_exp_ranks = dict(zip(mesh.axis_names, mesh.devices.shape))[expert_axis]
+    n_data = dict(zip(mesh.axis_names, mesh.devices.shape))[data_axis]
+    if cfg.n_experts % n_exp_ranks:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by "
+                         f"{n_exp_ranks} expert ranks")
+    specs = ep_param_specs(params, expert_axis)
+    n_dev = n_exp_ranks * n_data
+
+    def device_step(p, state: SolverState, tokens, targets, rng):
+        b_local, s_len = tokens.shape
+        inv_total = 1.0 / float(b_local * s_len * n_dev)
+
+        def loss_fn(pp):
+            logits, aux = moe_forward(pp, cfg, tokens,
+                                      expert_axis=expert_axis,
+                                      n_expert_ranks=n_exp_ranks)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            # local sums over the static GLOBAL normalizers: cross-device
+            # psum then reconstructs the exact global mean
+            return -jnp.sum(picked) * inv_total + aux / float(n_dev)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        grads = {lname: {leaf: (lax.psum(g, data_axis)
+                                if leaf in ("w1e", "w2e")
+                                else lax.psum(lax.psum(g, data_axis),
+                                              expert_axis))
+                         for leaf, g in lg.items()}
+                 for lname, lg in grads.items()}
+        upd = make_update_fn(sp, transformer_mults(p))
+        new_params, new_state = upd(p, grads, state)
+        metrics = {"loss": lax.psum(lax.psum(loss, data_axis), expert_axis)}
+        return new_params, new_state, metrics
+
+    state_spec = SolverState(it=P(), history=specs)
+    sharded = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(specs, state_spec, P((data_axis, expert_axis)),
+                  P((data_axis, expert_axis)), P()),
+        out_specs=(specs, state_spec, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())

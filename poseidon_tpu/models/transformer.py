@@ -89,12 +89,11 @@ def _dense(x, w):
         precision=matmul_precision())
 
 
-def block_forward(cfg: TransformerConfig, x: jax.Array, blk: Dict,
-                  *, seq_axis: Optional[str] = None) -> jax.Array:
-    """One decoder block: ln1 -> fused qkv -> (flash | ring) attention ->
-    wo residual -> ln2 -> gelu FFN residual. The single definition of the
-    block math — forward() and the pipeline path both call it (the tp path
-    differs structurally via its f/g collectives)."""
+def attention_sublayer(cfg: TransformerConfig, x: jax.Array, blk: Dict,
+                       *, seq_axis: Optional[str] = None) -> jax.Array:
+    """ln1 -> fused qkv -> (flash | ring) attention -> wo residual. Shared
+    by the dense block and the MoE block (models/moe.py), which differ only
+    in their FFN sublayer."""
     b, s, _ = x.shape
     h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
     qkv = _dense(h, blk["wqkv"])  # (B, S, 3*D)
@@ -108,10 +107,34 @@ def block_forward(cfg: TransformerConfig, x: jax.Array, blk: Dict,
     else:
         att = ring_attention(q, k, v, seq_axis, causal=True)
     att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)
-    x = x + _dense(att, blk["wo"]).astype(x.dtype)
+    return x + _dense(att, blk["wo"]).astype(x.dtype)
+
+
+def block_forward(cfg: TransformerConfig, x: jax.Array, blk: Dict,
+                  *, seq_axis: Optional[str] = None) -> jax.Array:
+    """One decoder block: attention sublayer + gelu FFN residual. The
+    single definition of the block math — forward() and the pipeline path
+    both call it (the tp path differs structurally via its f/g
+    collectives)."""
+    x = attention_sublayer(cfg, x, blk, seq_axis=seq_axis)
     h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
     ff = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
     return x + ff.astype(x.dtype)
+
+
+def embed_tokens(params: Dict, tokens: jax.Array,
+                 pos_offset: jax.Array | int = 0) -> jax.Array:
+    """Token + positional embedding — the model-entry scaffold shared by
+    the dense and MoE forwards."""
+    positions = pos_offset + jnp.arange(tokens.shape[-1])
+    return params["embed"]["w"][tokens] + params["pos"]["w"][positions]
+
+
+def lm_head(params: Dict, x: jax.Array) -> jax.Array:
+    """Final layer norm + vocabulary projection (f32 logits) — the
+    model-exit scaffold shared by the dense and MoE forwards."""
+    x = _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return _dense(x, params["head"]["w"]).astype(jnp.float32)
 
 
 def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
@@ -119,10 +142,7 @@ def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
             pos_offset: jax.Array | int = 0) -> jax.Array:
     """tokens (B, S_local) -> logits (B, S_local, V). With ``seq_axis``,
     attention runs as a ring over that mesh axis; everything else is local."""
-    b, s = tokens.shape
-    x = params["embed"]["w"][tokens]
-    positions = pos_offset + jnp.arange(s)
-    x = x + params["pos"]["w"][positions]
+    x = embed_tokens(params, tokens, pos_offset)
 
     def block(x, blk):
         return block_forward(cfg, x, blk, seq_axis=seq_axis)
@@ -133,8 +153,7 @@ def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
         block = jax.checkpoint(block)
     for i in range(len([k for k in params if k.startswith("block")])):
         x = block(x, params[f"block{i}"])
-    x = _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-    return _dense(x, params["head"]["w"]).astype(jnp.float32)
+    return lm_head(params, x)
 
 
 def lm_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
