@@ -1,13 +1,25 @@
-"""Long-context character LM on a 2-D (data x seq) mesh — runnable demo.
+"""Long-context character LM — runnable demo of every LM parallelism mode.
 
-The transformer family is this framework's beyond-the-reference flagship:
-batch shards over the "data" axis, the sequence over the "seq" axis (ring
-attention rotates K/V chunks over ICI; on TPU each chunk runs through the
-Pallas flash kernels), with Caffe-exact SGD doing the updates.
+The transformer family is this framework's beyond-the-reference flagship.
+``--mode`` picks the second mesh axis next to data parallelism:
+
+  sp  (default) ring attention over a ("data","seq") mesh — sequence chunks
+      rotate K/V over ICI; on TPU each chunk runs the Pallas flash kernels
+  tp  Megatron-style tensor parallelism over ("data","model") — heads/FFN
+      columns split, f/g conjugate collectives inside each block
+  pp  GPipe-style pipeline over ("data","stage") — layers split, microbatch
+      ticks on a ppermute ring, backward pipeline from autodiff
+  ep  switch MoE over ("data","expert") — top-1 routing, one all_to_all
+      pair per MoE layer
 
     # 8 virtual devices, 2 data x 4 sequence shards:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/lm/train_lm.py --steps 200 --seq 256
+
+    # same devices, tensor parallelism / pipeline / MoE:
+    ... train_lm.py --mode tp --steps 100
+    ... train_lm.py --mode pp --n_layers 4 --microbatches 2 --steps 100
+    ... train_lm.py --mode ep --experts 8 --steps 100
 
     # one real TPU chip (mesh collapses to 1x1):
     python examples/lm/train_lm.py --steps 500 --seq 2048 --bf16 --remat
@@ -29,6 +41,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("sp", "tp", "pp", "ep"), default="sp")
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--batch", type=int, default=8, help="global batch")
@@ -37,9 +50,12 @@ def main() -> None:
     ap.add_argument("--n_heads", type=int, default=4)
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--data_axis", type=int, default=0,
-                    help="data-axis size; 0 = auto (devices/seq_axis)")
-    ap.add_argument("--seq_axis", type=int, default=0,
-                    help="seq-axis size; 0 = auto (up to 4)")
+                    help="data-axis size; 0 = auto (devices/par_axis)")
+    ap.add_argument("--par_axis", type=int, default=0,
+                    help="size of the mode's axis (seq/model/stage/expert "
+                         "ranks); 0 = auto (up to 4)")
+    ap.add_argument("--microbatches", type=int, default=2, help="pp only")
+    ap.add_argument("--experts", type=int, default=8, help="ep only")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--display", type=int, default=20)
@@ -49,8 +65,8 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
     from poseidon_tpu import config
-    from poseidon_tpu.models.transformer import (
-        TransformerConfig, build_dp_sp_train_step, init_params)
+    from poseidon_tpu.models import moe as moe_mod
+    from poseidon_tpu.models import transformer as tfm
     from poseidon_tpu.parallel.mesh import make_mesh
     from poseidon_tpu.proto.messages import SolverParameter
     from poseidon_tpu.solvers.updates import init_state
@@ -59,28 +75,65 @@ def main() -> None:
         config.set_policy(compute_dtype=jnp.bfloat16)
 
     n_dev = jax.device_count()
-    if args.seq_axis:
-        seq_ax = args.seq_axis
+    if args.par_axis:
+        par_ax = args.par_axis
     else:  # largest divisor of the device count, at most 4
-        seq_ax = next(d for d in (4, 3, 2, 1) if n_dev % d == 0)
-    data_ax = args.data_axis or max(1, n_dev // seq_ax)
-    if data_ax * seq_ax != n_dev:
-        raise SystemExit(f"mesh {data_ax}x{seq_ax} != {n_dev} devices "
-                         f"(pick --data_axis/--seq_axis that multiply to "
+        par_ax = next(d for d in (4, 3, 2, 1) if n_dev % d == 0)
+    data_ax = args.data_axis or max(1, n_dev // par_ax)
+    if data_ax * par_ax != n_dev:
+        raise SystemExit(f"mesh {data_ax}x{par_ax} != {n_dev} devices "
+                         f"(pick --data_axis/--par_axis that multiply to "
                          f"{n_dev})")
-    if args.batch % data_ax or args.seq % seq_ax:
+    axis_name = {"sp": "seq", "tp": "model", "pp": "stage",
+                 "ep": "expert"}[args.mode]
+    batch_div = data_ax * (par_ax if args.mode == "ep" else 1)
+    if args.batch % batch_div or (args.mode == "sp"
+                                  and args.seq % par_ax):
         raise SystemExit(
-            f"--batch {args.batch} must divide by data axis {data_ax} and "
-            f"--seq {args.seq} by seq axis {seq_ax}")
-    mesh = make_mesh(axes=("data", "seq"), shape=(data_ax, seq_ax))
-    print(f"mesh: data={data_ax} x seq={seq_ax} ({n_dev} devices)")
+            f"--batch {args.batch} must divide by {batch_div}"
+            + (f" and --seq {args.seq} by {par_ax}"
+               if args.mode == "sp" else ""))
+    mesh = make_mesh(axes=("data", axis_name), shape=(data_ax, par_ax))
+    print(f"mesh: data={data_ax} x {axis_name}={par_ax} ({n_dev} devices)")
 
-    cfg = TransformerConfig(
+    cfg = tfm.TransformerConfig(
         vocab_size=256, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=4 * args.d_model,
         max_seq=args.seq, remat=args.remat)
     sp = SolverParameter(base_lr=args.lr, lr_policy="fixed", momentum=0.9)
-    step = build_dp_sp_train_step(cfg, sp, mesh, donate=False)
+    rng = jax.random.PRNGKey(0)
+    if args.mode == "sp":
+        params = tfm.init_params(cfg, rng)
+        step = tfm.build_dp_sp_train_step(cfg, sp, mesh, donate=False)
+    elif args.mode == "tp":
+        if args.n_heads % par_ax or (4 * args.d_model) % par_ax:
+            raise SystemExit(f"--n_heads {args.n_heads} and d_ff "
+                             f"{4 * args.d_model} must divide by the "
+                             f"model axis {par_ax}")
+        params = tfm.to_tp_layout(tfm.init_params(cfg, rng), cfg)
+        step = tfm.build_dp_tp_train_step(cfg, sp, mesh, params,
+                                          donate=False)
+    elif args.mode == "pp":
+        if args.n_layers % par_ax:
+            raise SystemExit(f"--n_layers {args.n_layers} must divide by "
+                             f"the stage axis {par_ax} (try --n_layers "
+                             f"{par_ax})")
+        if (args.batch // data_ax) % args.microbatches:
+            raise SystemExit(f"local batch {args.batch // data_ax} must "
+                             f"divide by --microbatches "
+                             f"{args.microbatches}")
+        params = tfm.to_pp_layout(tfm.init_params(cfg, rng), cfg)
+        step = tfm.build_dp_pp_train_step(
+            cfg, sp, mesh, params, microbatches=args.microbatches,
+            donate=False)
+    else:  # ep
+        if args.experts % par_ax:
+            raise SystemExit(f"--experts {args.experts} must divide by the "
+                             f"expert axis {par_ax}")
+        mcfg = moe_mod.MoEConfig(base=cfg, n_experts=args.experts)
+        params = moe_mod.init_moe_params(mcfg, rng)
+        step = moe_mod.build_dp_ep_train_step(mcfg, sp, mesh, params,
+                                              donate=False)
 
     # byte-level corpus: this very file, tiled so any --seq fits
     corpus = np.frombuffer(open(__file__, "rb").read(), np.uint8)
@@ -94,7 +147,6 @@ def main() -> None:
         return (jnp.asarray(toks[:, :-1].astype(np.int32)),
                 jnp.asarray(toks[:, 1:].astype(np.int32)))
 
-    params, state = init_params(cfg, jax.random.PRNGKey(0)), None
     state = init_state(params)
     t0 = steps_timed = 0
     for it in range(1, args.steps + 1):
