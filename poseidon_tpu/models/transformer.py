@@ -208,6 +208,72 @@ def build_dp_sp_train_step(cfg: TransformerConfig, sp: SolverParameter,
 # --------------------------------------------------------------------------- #
 
 
+def _check_tp_divisibility(cfg: TransformerConfig, mesh: Mesh,
+                           tp_axis: str) -> None:
+    n_tp = dict(zip(mesh.axis_names, mesh.devices.shape))[tp_axis]
+    if cfg.n_heads % n_tp or cfg.d_ff % n_tp:
+        raise ValueError(
+            f"n_heads={cfg.n_heads} and d_ff={cfg.d_ff} must both divide "
+            f"by the {n_tp} tensor-parallel ranks of axis {tp_axis!r}")
+
+
+def make_fg_ops(tp_axis: str):
+    """Megatron's conjugate collective pair as custom_vjps. ``f`` is
+    identity-forward / psum-backward (placed at each column-parallel
+    region's input); ``g`` is psum-forward / identity-backward (placed
+    after each row-parallel matmul). A raw lax.psum must not sit in the
+    differentiated path: its autodiff transpose is another psum, which
+    multiplies an already-replicated cotangent by the rank count
+    (measured: 4x per crossed psum on a 4-way tp mesh)."""
+
+    @jax.custom_vjp
+    def f_op(x):
+        return x
+
+    def _f_fwd(x):
+        return x, None
+
+    def _f_bwd(_, g):
+        return (lax.psum(g, tp_axis),)
+
+    f_op.defvjp(_f_fwd, _f_bwd)
+
+    @jax.custom_vjp
+    def g_op(x):
+        return lax.psum(x, tp_axis)
+
+    def _g_fwd(x):
+        return lax.psum(x, tp_axis), None
+
+    def _g_bwd(_, ct):
+        return (ct,)
+
+    g_op.defvjp(_g_fwd, _g_bwd)
+    return f_op, g_op
+
+
+def tp_block_forward(cfg: TransformerConfig, x: jax.Array, blk: Dict,
+                     f_op, g_op) -> jax.Array:
+    """One decoder block with tensor-parallel weights: this rank's head
+    slices + FFN columns, partial outputs restored by ``g_op``'s psum.
+    Shared by the dp x tp step and the 3-D dp x pp x tp step."""
+    b, s, _ = x.shape
+    dh = cfg.d_model // cfg.n_heads
+    h = f_op(_layer_norm(x, blk["ln1_g"], blk["ln1_b"]))
+    qkv = _dense(h, blk["wqkv"])          # (B, S, Hl*3*dh)
+    hl = qkv.shape[-1] // (3 * dh)        # local heads on this rank
+    qkv = qkv.reshape(b, s, hl, 3, dh)
+    q, k, v = (qkv[:, :, :, j].swapaxes(1, 2) for j in range(3))
+    att = maybe_flash_attention(q, k, v, causal=True)
+    att = att.swapaxes(1, 2).reshape(b, s, hl * dh)
+    # row-parallel wo: partial product, summed across ranks
+    part = _dense(att, blk["wo"])
+    x = x + g_op(part).astype(x.dtype)
+    h = f_op(_layer_norm(x, blk["ln2_g"], blk["ln2_b"]))
+    ff_part = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
+    return x + g_op(ff_part).astype(x.dtype)
+
+
 def to_tp_layout(params: Dict, cfg: TransformerConfig) -> Dict:
     """Rearrange each block's fused qkv weight from [q-heads; k-heads;
     v-heads] row order to HEAD-major [(q,k,v) of head 0; (q,k,v) of head 1;
@@ -287,52 +353,11 @@ def build_dp_tp_train_step(cfg: TransformerConfig, sp: SolverParameter,
     params positionally); the sharding is published via
     ``tp_param_specs``."""
     specs = tp_param_specs(params, tp_axis)
-
-    @jax.custom_vjp
-    def f_op(x):
-        return x
-
-    def _f_fwd(x):
-        return x, None
-
-    def _f_bwd(_, g):
-        return (lax.psum(g, tp_axis),)
-
-    f_op.defvjp(_f_fwd, _f_bwd)
-
-    @jax.custom_vjp
-    def g_op(x):
-        return lax.psum(x, tp_axis)
-
-    def _g_fwd(x):
-        return lax.psum(x, tp_axis), None
-
-    def _g_bwd(_, ct):
-        # the conjugate of f: psum forward, IDENTITY backward — a raw
-        # lax.psum must not sit in the differentiated path because its
-        # autodiff transpose is another psum, which multiplies an
-        # already-replicated cotangent by the rank count (measured: 4x per
-        # crossed psum on a 4-way tp mesh)
-        return (ct,)
-
-    g_op.defvjp(_g_fwd, _g_bwd)
+    _check_tp_divisibility(cfg, mesh, tp_axis)
+    f_op, g_op = make_fg_ops(tp_axis)
 
     def block_tp(x, blk):
-        b, s, _ = x.shape
-        dh = cfg.d_model // cfg.n_heads
-        h = f_op(_layer_norm(x, blk["ln1_g"], blk["ln1_b"]))
-        qkv = _dense(h, blk["wqkv"])          # (B, S, Hl*3*dh)
-        hl = qkv.shape[-1] // (3 * dh)        # local heads on this rank
-        qkv = qkv.reshape(b, s, hl, 3, dh)
-        q, k, v = (qkv[:, :, :, j].swapaxes(1, 2) for j in range(3))
-        att = maybe_flash_attention(q, k, v, causal=True)
-        att = att.swapaxes(1, 2).reshape(b, s, hl * dh)
-        # row-parallel wo: partial product, summed across ranks
-        part = _dense(att, blk["wo"])
-        x = x + g_op(part).astype(x.dtype)
-        h = f_op(_layer_norm(x, blk["ln2_g"], blk["ln2_b"]))
-        ff_part = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
-        return x + g_op(ff_part).astype(x.dtype)
+        return tp_block_forward(cfg, x, blk, f_op, g_op)
 
     def forward_tp(p, tokens):
         b, s = tokens.shape
@@ -397,10 +422,22 @@ def from_pp_layout(params: Dict, cfg: TransformerConfig) -> Dict:
     return out
 
 
-def pp_param_specs(params: Dict, stage_axis: str = "stage") -> Dict:
+def pp_param_specs(params: Dict, stage_axis: str = "stage",
+                   tp_axis: Optional[str] = None) -> Dict:
     """PartitionSpec pytree for the PP layout: stacked block leaves split on
-    the layer axis over ``stage_axis``, everything else replicated."""
-    return {lname: {leaf: (P(stage_axis) if lname == "blocks" else P())
+    the layer axis over ``stage_axis``, everything else replicated. With
+    ``tp_axis``, block weights additionally split tensor-parallel (columns
+    for wqkv/w1, rows for wo/w2 — the 3-D dp x pp x tp layout)."""
+    if tp_axis is None:
+        return {lname: {leaf: (P(stage_axis) if lname == "blocks" else P())
+                        for leaf in lp}
+                for lname, lp in params.items()}
+    tp_spec = {"wqkv": P(stage_axis, tp_axis),
+               "wo": P(stage_axis, None, tp_axis),
+               "w1": P(stage_axis, tp_axis),
+               "w2": P(stage_axis, None, tp_axis)}
+    return {lname: {leaf: (tp_spec.get(leaf, P(stage_axis))
+                           if lname == "blocks" else P())
                     for leaf in lp}
             for lname, lp in params.items()}
 
@@ -408,7 +445,9 @@ def pp_param_specs(params: Dict, stage_axis: str = "stage") -> Dict:
 def build_dp_pp_train_step(cfg: TransformerConfig, sp: SolverParameter,
                            mesh: Mesh, params: Dict, microbatches: int,
                            data_axis: str = "data",
-                           stage_axis: str = "stage", donate: bool = True):
+                           stage_axis: str = "stage",
+                           tp_axis: Optional[str] = None,
+                           donate: bool = True):
     """Training step over a 2-D (data x stage) mesh — GPipe-style pipeline
     parallelism as ONE differentiable compiled program, not a scheduler.
     Where a CUDA framework hand-writes a 1F1B schedule with per-stage
@@ -438,13 +477,31 @@ def build_dp_pp_train_step(cfg: TransformerConfig, sp: SolverParameter,
     everything pmeans over ``data_axis``. The per-device loss scalar stays
     un-psum'd inside ``loss_fn`` for the same reason; the metric sums
     across stages afterwards. Requires n_layers % n_stages == 0 and
-    local batch % microbatches == 0."""
+    local batch % microbatches == 0.
+
+    With ``tp_axis`` this becomes the standard 3-D recipe (dp x pp x tp):
+    each stage's blocks run ``tp_block_forward`` (this rank's head slices /
+    FFN columns, f/g conjugate collectives over ``tp_axis``), so pass
+    params through ``to_pp_layout(to_tp_layout(...))``. The grad sync is
+    unchanged: block grads stay local (tp-sharded leaves complete per rank,
+    per-stage ln leaves bit-identical across tp ranks via f/g), non-block
+    leaves still psum over ``stage_axis`` only — they are computed in full
+    on every tp rank, so a tp psum would over-count."""
     n_stage = dict(zip(mesh.axis_names, mesh.devices.shape))[stage_axis]
     n_layers = next(iter(params["blocks"].values())).shape[0]
     if n_layers % n_stage:
         raise ValueError(f"n_layers={n_layers} not divisible by "
                          f"{n_stage} pipeline stages")
-    specs = pp_param_specs(params, stage_axis)
+    specs = pp_param_specs(params, stage_axis, tp_axis)
+    if tp_axis is None:
+        def stage_block(h, blk):
+            return block_forward(cfg, h, blk)
+    else:
+        _check_tp_divisibility(cfg, mesh, tp_axis)
+        f_op, g_op = make_fg_ops(tp_axis)
+
+        def stage_block(h, blk):
+            return tp_block_forward(cfg, h, blk, f_op, g_op)
 
     def device_step(p, state: SolverState, tokens, targets, rng):
         stage = lax.axis_index(stage_axis)
@@ -468,7 +525,7 @@ def build_dp_pp_train_step(cfg: TransformerConfig, sp: SolverParameter,
             x = jnp.where(stage == 0, fresh, x)
             # this stage's run of layers
             def body(h, blk):
-                return block_forward(cfg, h, blk), None
+                return stage_block(h, blk), None
             x, _ = lax.scan(body, x, pp["blocks"])
             # egress (kept by the last stage once the pipe is full):
             # microbatch t - (n_stage - 1) retires at tick t
