@@ -220,17 +220,17 @@ def load_caffemodel(path: str, net: Net, params):
     return net.load_weights(params, weights)
 
 
-def latest_snapshot(prefix: str) -> Optional[str]:
+def latest_snapshot(prefix: str,
+                    suffix: str = ".solverstate.npz") -> Optional[str]:
     d = os.path.dirname(prefix) or "."
     base = os.path.basename(prefix)
     best, best_it = None, -1
     if not os.path.isdir(d):
         return None
     for name in os.listdir(d):
-        if name.startswith(base + "_iter_") and \
-                name.endswith(".solverstate.npz"):
+        if name.startswith(base + "_iter_") and name.endswith(suffix):
             try:
-                it = int(name[len(base + "_iter_"):-len(".solverstate.npz")])
+                it = int(name[len(base + "_iter_"):-len(suffix)])
             except ValueError:
                 continue
             if it > best_it:

@@ -1,0 +1,90 @@
+"""Checkpoint/resume for the LM model family (transformer + MoE pytrees).
+
+The CNN engine snapshots through the wire-compatible `.caffemodel` path
+(`runtime/checkpoint.py`, the analog of the reference's Snapshot/Restore,
+solver.cpp:654-667). The LM family's parameters are plain pytrees that may
+live in a parallelism-specific layout (tp head-major splits, pp stacked
+layers, or both for 3-D). Snapshots here are always written in the
+CANONICAL layout (per-block dicts, single-device shapes) so a checkpoint
+taken under any parallelism mode resumes under any other — the LM analog of
+the CNN path's cross-mode `coerce_state` (SSP<->sync, flat<->two-tier).
+
+Atomicity follows the same tmp+rename rule as the engine snapshots: with
+replicated (or canonically gathered) state every rank writes identical
+bytes, so the last rename wins with valid content."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..solvers.updates import SolverState
+from .checkpoint import _flatten, _unflatten
+
+
+def _canonicalize(tree: Dict, cfg, layout: Sequence[str]) -> Dict:
+    """Undo layout transforms in reverse application order: a tree built as
+    ``to_pp_layout(to_tp_layout(plain))`` has layout ("tp", "pp")."""
+    from ..models.transformer import from_pp_layout, from_tp_layout
+    undo = {"tp": from_tp_layout, "pp": from_pp_layout}
+    for name in reversed(tuple(layout)):
+        tree = undo[name](tree, cfg)
+    return tree
+
+
+def _apply_layout(tree: Dict, cfg, layout: Sequence[str]) -> Dict:
+    from ..models.transformer import to_pp_layout, to_tp_layout
+    redo = {"tp": to_tp_layout, "pp": to_pp_layout}
+    for name in tuple(layout):
+        tree = redo[name](tree, cfg)
+    return tree
+
+
+def save_lm(prefix: str, params: Dict, state: SolverState, cfg, *,
+            layout: Sequence[str] = ()) -> str:
+    """Write ``<prefix>_iter_N.lmstate.npz`` in canonical layout.
+
+    ``layout`` names the transforms the live pytrees carry, in application
+    order — () for sp/ep runs (params are canonical already), ("tp",) /
+    ("pp",) for 2-D tp/pp, ("tp", "pp") for the 3-D recipe. The momentum
+    history mirrors the param tree, so the same undo applies."""
+    params = jax.device_get(_canonicalize(params, cfg, layout))
+    history = jax.device_get(_canonicalize(state.history, cfg, layout))
+    it = int(state.it)
+    os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+    path = f"{prefix}_iter_{it}.lmstate.npz"
+    arrays = {"iter": np.asarray(it)}
+    arrays.update({f"params/{k}": v for k, v in _flatten(params).items()})
+    arrays.update({f"history/{k}": v for k, v in _flatten(history).items()})
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def restore_lm(path: str, cfg, *,
+               layout: Sequence[str] = ()) -> Tuple[Dict, SolverState]:
+    """Rebuild (params, SolverState) from a canonical snapshot, re-applying
+    ``layout`` for the resuming topology (which need not match the saving
+    one)."""
+    z = np.load(path)
+    groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "history": {}}
+    for key in z.files:
+        head, _, rest = key.partition("/")
+        if head in groups:
+            groups[head][rest] = z[key]
+    params = _apply_layout(_unflatten(groups["params"]), cfg, layout)
+    history = _apply_layout(_unflatten(groups["history"]), cfg, layout)
+    import jax.numpy as jnp
+    state = SolverState(it=jnp.asarray(int(z["iter"]), jnp.int32),
+                        history=history)
+    return params, state
+
+
+def latest_lm_snapshot(prefix: str) -> Optional[str]:
+    from .checkpoint import latest_snapshot
+    return latest_snapshot(prefix, suffix=".lmstate.npz")
