@@ -1,0 +1,125 @@
+"""Autoregressive decoding with a KV cache for the transformer family.
+
+The reference's inference surface is `caffe test` / feature extraction
+(tools/caffe_main.cpp:188-255, runtime/tools.py here); for the LM family the
+analog is generation. TPU-idiomatic decode: the whole loop is ONE
+`lax.scan` under jit — static shapes throughout (caches are preallocated at
+prompt+max_new length, visibility is a position mask, one token per tick),
+no Python control flow on device values.
+
+Prefill and decode share `_block_cached`: prefill runs it once over the
+full prompt (S = P) writing the caches, decode runs it with S = 1 per tick.
+Attention here is plain dot-product against the cache — a single-query
+attend is HBM-bound gather work where the flash kernel's tiling buys
+nothing (the training paths keep routing through
+`ops/pallas_kernels.maybe_flash_attention`)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .transformer import (TransformerConfig, _dense, _layer_norm,
+                          embed_tokens, ffn_sublayer, lm_head)
+
+
+def _attend_cached(q, ck, cv, q_pos0):
+    """q (B,H,S,Dh) against caches (B,H,T,Dh); key j is visible to query
+    i iff j <= q_pos0 + i (future cache slots are zero-filled and masked)."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                        ck.astype(jnp.float32)) / np.sqrt(dh)
+    t = ck.shape[2]
+    i = q_pos0 + jnp.arange(q.shape[2])
+    visible = jnp.arange(t)[None, :] <= i[:, None]        # (S, T)
+    scores = jnp.where(visible[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, cv.astype(jnp.float32))
+
+
+def _block_cached(cfg: TransformerConfig, x, blk, ck, cv, pos0):
+    """One decoder block writing new K/V at ``pos0`` and attending against
+    the (updated) cache. Returns (x_out, ck, cv)."""
+    b, s, _ = x.shape
+    dh = cfg.d_model // cfg.n_heads
+    h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
+    qkv = _dense(h, blk["wqkv"]).reshape(b, s, 3, cfg.n_heads, dh)
+    q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))  # (B,H,S,Dh)
+    ck = lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos0, axis=2)
+    cv = lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos0, axis=2)
+    att = _attend_cached(q, ck, cv, pos0)
+    att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)
+    x = x + _dense(att, blk["wo"]).astype(x.dtype)
+    return ffn_sublayer(x, blk), ck, cv
+
+
+def _forward_cached(params: Dict, cfg: TransformerConfig, tokens, caches,
+                    pos0):
+    """tokens (B, S) starting at absolute position pos0 -> (logits of the
+    LAST position (B, V), updated caches)."""
+    x = embed_tokens(params, tokens, pos_offset=pos0)
+    new_caches = []
+    for i in range(cfg.n_layers):
+        x, ck, cv = _block_cached(cfg, x, params[f"block{i}"],
+                                  *caches[i], pos0)
+        new_caches.append((ck, cv))
+    return lm_head(params, x)[:, -1], tuple(new_caches)
+
+
+def generate(params: Dict, cfg: TransformerConfig, prompt: jax.Array,
+             max_new: int, *, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Greedy (temperature 0) or sampled decoding.
+
+    prompt (B, P) int32 -> (generated tokens (B, max_new), per-step logits
+    (B, max_new, V)). Requires P + max_new <= cfg.max_seq (learned
+    positions)."""
+    b, p_len = prompt.shape
+    total = p_len + max_new
+    if total > cfg.max_seq:
+        raise ValueError(f"prompt {p_len} + max_new {max_new} exceeds "
+                         f"max_seq {cfg.max_seq}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    # the greedy-vs-sample BRANCH is static; the temperature VALUE is
+    # traced, so a sweep over temperatures shares one compilation
+    return _run(params, prompt, rng, jnp.float32(temperature), cfg,
+                max_new, temperature > 0.0)
+
+
+def _run_impl(params, prompt, rng, temperature, cfg, max_new, sample):
+    b, p_len = prompt.shape
+    total = p_len + max_new
+    dh = cfg.d_model // cfg.n_heads
+    caches = tuple(
+        (jnp.zeros((b, cfg.n_heads, total, dh), jnp.float32),
+         jnp.zeros((b, cfg.n_heads, total, dh), jnp.float32))
+        for _ in range(cfg.n_layers))
+    logits, caches = _forward_cached(params, cfg, prompt, caches, 0)
+
+    def pick(logits, key):
+        if sample:
+            return jax.random.categorical(key, logits / temperature,
+                                          axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def tick(carry, key):
+        caches, logits, pos = carry
+        tok = pick(logits, key).astype(jnp.int32)
+        next_logits, caches = _forward_cached(
+            params, cfg, tok[:, None], caches, pos)
+        return (caches, next_logits, pos + 1), (tok, logits)
+
+    keys = jax.random.split(rng, max_new)
+    _, (toks, step_logits) = lax.scan(
+        tick, (caches, logits, jnp.asarray(p_len, jnp.int32)), keys)
+    return toks.swapaxes(0, 1), step_logits.swapaxes(0, 1)
+
+
+_run = jax.jit(_run_impl, static_argnames=("cfg", "max_new", "sample"))

@@ -110,16 +110,22 @@ def attention_sublayer(cfg: TransformerConfig, x: jax.Array, blk: Dict,
     return x + _dense(att, blk["wo"]).astype(x.dtype)
 
 
+def ffn_sublayer(x: jax.Array, blk: Dict) -> jax.Array:
+    """ln2 -> gelu FFN -> residual. Shared by the dense block and the
+    KV-cached decode block (models/generate.py)."""
+    h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+    ff = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
+    return x + ff.astype(x.dtype)
+
+
 def block_forward(cfg: TransformerConfig, x: jax.Array, blk: Dict,
                   *, seq_axis: Optional[str] = None) -> jax.Array:
     """One decoder block: attention sublayer + gelu FFN residual. The
     single definition of the block math — forward() and the pipeline path
     both call it (the tp path differs structurally via its f/g
     collectives)."""
-    x = attention_sublayer(cfg, x, blk, seq_axis=seq_axis)
-    h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
-    ff = _dense(jax.nn.gelu(_dense(h, blk["w1"])), blk["w2"])
-    return x + ff.astype(x.dtype)
+    return ffn_sublayer(attention_sublayer(cfg, x, blk, seq_axis=seq_axis),
+                        blk)
 
 
 def embed_tokens(params: Dict, tokens: jax.Array,

@@ -71,17 +71,17 @@ def restore_lm(path: str, cfg, *,
     """Rebuild (params, SolverState) from a canonical snapshot, re-applying
     ``layout`` for the resuming topology (which need not match the saving
     one)."""
-    z = np.load(path)
     groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "history": {}}
-    for key in z.files:
-        head, _, rest = key.partition("/")
-        if head in groups:
-            groups[head][rest] = z[key]
+    with np.load(path) as z:
+        it = int(z["iter"])
+        for key in z.files:
+            head, _, rest = key.partition("/")
+            if head in groups:
+                groups[head][rest] = z[key]
     params = _apply_layout(_unflatten(groups["params"]), cfg, layout)
     history = _apply_layout(_unflatten(groups["history"]), cfg, layout)
     import jax.numpy as jnp
-    state = SolverState(it=jnp.asarray(int(z["iter"]), jnp.int32),
-                        history=history)
+    state = SolverState(it=jnp.asarray(it, jnp.int32), history=history)
     return params, state
 
 

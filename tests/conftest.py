@@ -35,3 +35,15 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng_np():
     return np.random.RandomState(0)
+
+def pattern_batch(rs, b, s, vocab):
+    """The LM test-suite task: t[i+1] = (3 t[i] + 1) mod vocab — learnable
+    by a tiny decoder in ~100 steps. Returns (tokens, targets), each (b, s).
+    Shared by the transformer/moe/generate/checkpoint suites."""
+    import jax.numpy as jnp
+    start = rs.randint(0, vocab, size=(b, 1))
+    seq = [start]
+    for _ in range(s):
+        seq.append((seq[-1] * 3 + 1) % vocab)
+    full = np.concatenate(seq, axis=1)
+    return jnp.asarray(full[:, :s]), jnp.asarray(full[:, 1:s + 1])

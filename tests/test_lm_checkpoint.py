@@ -12,19 +12,15 @@ from poseidon_tpu.runtime.lm_checkpoint import (
     latest_lm_snapshot, restore_lm, save_lm)
 from poseidon_tpu.solvers.updates import init_state, make_update_fn
 
+from conftest import pattern_batch
+
 CFG = TransformerConfig(vocab_size=32, d_model=64, n_heads=2, n_layers=2,
                         d_ff=128, max_seq=64)
 B, S = 8, 32
 
 
 def _batch(rs, b, s):
-    start = rs.randint(0, CFG.vocab_size, size=(b, 1))
-    seq = [start]
-    for _ in range(s):
-        seq.append((seq[-1] * 3 + 1) % CFG.vocab_size)
-    full = np.concatenate(seq, axis=1)
-    import jax.numpy as jnp
-    return jnp.asarray(full[:, :s]), jnp.asarray(full[:, 1:s + 1])
+    return pattern_batch(rs, b, s, CFG.vocab_size)
 
 
 def test_cross_topology_resume_matches_uninterrupted_run(tmp_path):

@@ -15,6 +15,8 @@ from poseidon_tpu.parallel.mesh import make_mesh
 from poseidon_tpu.proto.messages import SolverParameter
 from poseidon_tpu.solvers.updates import init_state, make_update_fn
 
+from conftest import pattern_batch
+
 BASE = TransformerConfig(vocab_size=32, d_model=32, n_heads=2, n_layers=2,
                          d_ff=64, max_seq=32)
 CFG = MoEConfig(base=BASE, n_experts=8, capacity=16, aux_weight=0.0)
@@ -22,12 +24,7 @@ B, S = 8, 16  # global batch/seq; mesh (data=2, expert=4) -> 16 tokens/device
 
 
 def _pattern_batch(rs, b, s):
-    start = rs.randint(0, BASE.vocab_size, size=(b, 1))
-    seq = [start]
-    for _ in range(s):
-        seq.append((seq[-1] * 3 + 1) % BASE.vocab_size)
-    full = np.concatenate(seq, axis=1)
-    return jnp.asarray(full[:, :s]), jnp.asarray(full[:, 1:s + 1])
+    return pattern_batch(rs, b, s, BASE.vocab_size)
 
 
 def test_dp_ep_matches_single_device_gradstep():

@@ -11,6 +11,8 @@ from poseidon_tpu.parallel.mesh import make_mesh
 from poseidon_tpu.proto.messages import SolverParameter
 from poseidon_tpu.solvers.updates import init_state
 
+from conftest import pattern_batch
+
 CFG = TransformerConfig(vocab_size=32, d_model=64, n_heads=4, n_layers=2,
                         d_ff=128, max_seq=64)
 B, S = 4, 32  # global batch/sequence; mesh (data=2, seq=4)
@@ -22,13 +24,7 @@ def mesh():
 
 
 def _pattern_batch(rs, b, s):
-    """Learnable task: tokens follow t[i+1] = (t[i] * 3 + 1) mod V."""
-    start = rs.randint(0, CFG.vocab_size, size=(b, 1))
-    seq = [start]
-    for _ in range(s):
-        seq.append((seq[-1] * 3 + 1) % CFG.vocab_size)
-    full = np.concatenate(seq, axis=1)
-    return jnp.asarray(full[:, :s]), jnp.asarray(full[:, 1:s + 1])
+    return pattern_batch(rs, b, s, CFG.vocab_size)
 
 
 def test_forward_shapes_and_causality():
