@@ -15,7 +15,7 @@ per-blob learning-rate / weight-decay multipliers.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Tuple
 
 from ..proto.messages import FillerParameter

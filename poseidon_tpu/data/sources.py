@@ -11,7 +11,7 @@ memory_data}_layer.cpp`` and ``include/caffe/data_layers.hpp:73-122``.
 from __future__ import annotations
 
 import os
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import List, Tuple
 
 import numpy as np
 

@@ -15,7 +15,6 @@ rotate along "seq" only.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -23,13 +22,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import matmul_precision, policy
 from ..ops.pallas_kernels import maybe_flash_attention
 from ..parallel.sequence import ring_attention
 from ..proto.messages import SolverParameter
-from ..solvers.updates import SolverState, init_state, make_update_fn
+from ..solvers.updates import SolverState, make_update_fn
 
 
 @dataclass(frozen=True)

@@ -15,7 +15,6 @@ periodic local-SGD, since a compiled SPMD program has no asynchronous clock.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Callable, Dict, NamedTuple, Optional, Tuple
 

@@ -13,7 +13,6 @@ binary NetParameter (``.caffemodel``, written by rank 0) and per-worker
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Dict, Optional, Tuple
 

@@ -30,7 +30,7 @@ trans_time_estimate.hpp:10-15, applied to the static bytes).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional
 
 import numpy as np

@@ -29,19 +29,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.net import Net
-from ..data.pipeline import (BatchPipeline, build_phase_pipelines,
-                             layer_batch_size)
+from ..data.pipeline import BatchPipeline, build_phase_pipelines
 from ..data.workload import Shard
-from ..core.layers import DATA_SOURCE_TYPES
 from ..parallel import (CommConfig, build_eval_step, build_ssp_train_step,
                         build_train_step, init_ssp_state, init_train_state,
                         make_mesh)
-from ..parallel.trainer import (SSPState, TrainStep, comm_error_groups,
-                                stack_batches)
-from ..proto.messages import (NetParameter, SolverParameter, load_net,
-                              load_solver)
+from ..parallel.trainer import TrainStep, comm_error_groups, stack_batches
+from ..proto.messages import NetParameter, SolverParameter, load_net
 from ..solvers.updates import learning_rate
-from .checkpoint import latest_snapshot, load_caffemodel, restore, snapshot
+from .checkpoint import load_caffemodel, restore, snapshot
 from .metrics import MetricsTable, StatsRegistry, log
 
 

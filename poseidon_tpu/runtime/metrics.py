@@ -10,11 +10,10 @@ them per display window and writes the same artifact shapes (CSV + YAML).
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 class MetricsTable:
