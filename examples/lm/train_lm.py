@@ -59,6 +59,9 @@ def main() -> None:
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--display", type=int, default=20)
+    ap.add_argument("--generate", type=int, default=0, metavar="N",
+                    help="after training, greedy-decode N bytes from a "
+                         "corpus prompt (sp/tp/pp modes)")
     args = ap.parse_args()
 
     import jax
@@ -166,6 +169,30 @@ def main() -> None:
             tps = steps_timed * args.batch * args.seq / dt
             print(f"step {it:5d}  loss {float(metrics['loss']):.4f}  "
                   f"{tps:,.0f} tok/s", flush=True)
+
+    if args.generate:
+        if args.generate > cfg.max_seq - 8:
+            raise SystemExit(f"--generate {args.generate} must be < "
+                             f"max_seq - 8 = {cfg.max_seq - 8} (learned "
+                             f"positions cover prompt + generation)")
+        if args.mode == "ep":
+            print("--generate: MoE decode not wired; skipping")
+        else:
+            from poseidon_tpu.models.generate import generate as gen
+            # decoding runs on canonical (single-device) params
+            plain = params
+            if args.mode == "tp":
+                plain = tfm.from_tp_layout(params, cfg)
+            elif args.mode == "pp":
+                plain = tfm.from_pp_layout(params, cfg)
+            p_len = max(1, min(32, cfg.max_seq - args.generate))
+            prompt = jnp.asarray(
+                corpus[None, :p_len].astype(np.int32))
+            toks, _ = gen(plain, cfg, prompt, args.generate)
+            text = bytes(np.asarray(toks)[0].astype(np.uint8)).decode(
+                "utf-8", errors="replace")
+            print(f"prompt: {bytes(corpus[:p_len]).decode('utf-8', errors='replace')!r}")
+            print(f"generated: {text!r}")
     print("done")
 
 
