@@ -61,7 +61,7 @@ def main() -> None:
     ap.add_argument("--display", type=int, default=20)
     ap.add_argument("--generate", type=int, default=0, metavar="N",
                     help="after training, greedy-decode N bytes from a "
-                         "corpus prompt (sp/tp/pp modes)")
+                         "corpus prompt (all modes; MoE decodes dropless)")
     args = ap.parse_args()
 
     import jax
@@ -175,24 +175,25 @@ def main() -> None:
             raise SystemExit(f"--generate {args.generate} must be < "
                              f"max_seq - 8 = {cfg.max_seq - 8} (learned "
                              f"positions cover prompt + generation)")
-        if args.mode == "ep":
-            print("--generate: MoE decode not wired; skipping")
-        else:
-            from poseidon_tpu.models.generate import generate as gen
-            # decoding runs on canonical (single-device) params
-            plain = params
-            if args.mode == "tp":
-                plain = tfm.from_tp_layout(params, cfg)
-            elif args.mode == "pp":
-                plain = tfm.from_pp_layout(params, cfg)
-            p_len = max(1, min(32, cfg.max_seq - args.generate))
-            prompt = jnp.asarray(
-                corpus[None, :p_len].astype(np.int32))
-            toks, _ = gen(plain, cfg, prompt, args.generate)
-            text = bytes(np.asarray(toks)[0].astype(np.uint8)).decode(
-                "utf-8", errors="replace")
-            print(f"prompt: {bytes(corpus[:p_len]).decode('utf-8', errors='replace')!r}")
-            print(f"generated: {text!r}")
+        from poseidon_tpu.models.generate import generate as gen
+        # decoding runs on canonical (single-device) params; MoE decode
+        # routes all experts locally (dropless)
+        plain, gen_cfg = params, cfg
+        if args.mode == "tp":
+            plain = tfm.from_tp_layout(params, cfg)
+        elif args.mode == "pp":
+            plain = tfm.from_pp_layout(params, cfg)
+        elif args.mode == "ep":
+            gen_cfg = mcfg
+        p_len = max(1, min(32, cfg.max_seq - args.generate))
+        prompt = jnp.asarray(
+            corpus[None, :p_len].astype(np.int32))
+        toks, _ = gen(plain, gen_cfg, prompt, args.generate)
+        text = bytes(np.asarray(toks)[0].astype(np.uint8)).decode(
+            "utf-8", errors="replace")
+        print(f"prompt: "
+              f"{bytes(corpus[:p_len]).decode('utf-8', errors='replace')!r}")
+        print(f"generated: {text!r}")
     print("done")
 
 

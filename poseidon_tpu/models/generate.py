@@ -41,7 +41,8 @@ def _attend_cached(q, ck, cv, q_pos0):
     return jnp.einsum("bhst,bhtd->bhsd", probs, cv.astype(jnp.float32))
 
 
-def _block_cached(cfg: TransformerConfig, x, blk, ck, cv, pos0):
+def _block_cached(cfg: TransformerConfig, x, blk, ck, cv, pos0, *,
+                  moe_cfg=None):
     """One decoder block writing new K/V at ``pos0`` and attending against
     the (updated) cache. Returns (x_out, ck, cv)."""
     b, s, _ = x.shape
@@ -54,36 +55,67 @@ def _block_cached(cfg: TransformerConfig, x, blk, ck, cv, pos0):
     att = _attend_cached(q, ck, cv, pos0)
     att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)
     x = x + _dense(att, blk["wo"]).astype(x.dtype)
+    if moe_cfg is not None:
+        import dataclasses
+        from .moe import moe_ffn
+        h = _layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+        flat = h.reshape(b * s, cfg.d_model)
+        # decode is DROPLESS: capacity queues bound training throughput;
+        # at inference every routed token must reach its expert. Dropless
+        # dispatch is one-hot over capacity = token count, an O(C^2 * E)
+        # tensor — so prefill processes tokens in chunks (routing is
+        # per-token, chunking changes nothing) to bound it
+        chunk = min(flat.shape[0], 256)
+        outs = []
+        for lo in range(0, flat.shape[0], chunk):
+            part = flat[lo:lo + chunk]
+            dec = dataclasses.replace(moe_cfg, capacity=part.shape[0])
+            y, _ = moe_ffn(part, blk["wg"], blk["w1e"], blk["w2e"], dec)
+            outs.append(y)
+        y = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+        return x + y.reshape(b, s, cfg.d_model).astype(x.dtype), ck, cv
     return ffn_sublayer(x, blk), ck, cv
 
 
-def _forward_cached(params: Dict, cfg: TransformerConfig, tokens, caches,
-                    pos0):
+def _forward_cached(params: Dict, cfg, tokens, caches, pos0):
     """tokens (B, S) starting at absolute position pos0 -> (logits of the
-    LAST position (B, V), updated caches)."""
+    LAST position (B, V), updated caches). ``cfg`` is a TransformerConfig
+    or an MoEConfig — MoE blocks route their FFN through moe_ffn with all
+    experts local (decode is single-program; expert sharding is a training
+    concern)."""
+    bcfg, moe_cfg = _split_cfg(cfg)
     x = embed_tokens(params, tokens, pos_offset=pos0)
     new_caches = []
-    for i in range(cfg.n_layers):
-        x, ck, cv = _block_cached(cfg, x, params[f"block{i}"],
-                                  *caches[i], pos0)
+    for i in range(bcfg.n_layers):
+        blk = params[f"block{i}"]
+        x, ck, cv = _block_cached(bcfg, x, blk, *caches[i], pos0,
+                                  moe_cfg=moe_cfg)
         new_caches.append((ck, cv))
     return lm_head(params, x)[:, -1], tuple(new_caches)
 
 
-def generate(params: Dict, cfg: TransformerConfig, prompt: jax.Array,
+def _split_cfg(cfg):
+    """(base TransformerConfig, MoEConfig | None) from either config."""
+    base = getattr(cfg, "base", None)
+    return (base, cfg) if base is not None else (cfg, None)
+
+
+def generate(params: Dict, cfg, prompt: jax.Array,
              max_new: int, *, temperature: float = 0.0,
              rng: Optional[jax.Array] = None
              ) -> Tuple[jax.Array, jax.Array]:
     """Greedy (temperature 0) or sampled decoding.
 
     prompt (B, P) int32 -> (generated tokens (B, max_new), per-step logits
-    (B, max_new, V)). Requires P + max_new <= cfg.max_seq (learned
+    (B, max_new, V)). ``cfg`` is a TransformerConfig (dense) or MoEConfig
+    (switch FFN blocks). Requires P + max_new <= max_seq (learned
     positions)."""
+    bcfg, _ = _split_cfg(cfg)
     b, p_len = prompt.shape
     total = p_len + max_new
-    if total > cfg.max_seq:
+    if total > bcfg.max_seq:
         raise ValueError(f"prompt {p_len} + max_new {max_new} exceeds "
-                         f"max_seq {cfg.max_seq}")
+                         f"max_seq {bcfg.max_seq}")
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
     rng = jax.random.PRNGKey(0) if rng is None else rng
@@ -94,13 +126,14 @@ def generate(params: Dict, cfg: TransformerConfig, prompt: jax.Array,
 
 
 def _run_impl(params, prompt, rng, temperature, cfg, max_new, sample):
+    bcfg, _ = _split_cfg(cfg)
     b, p_len = prompt.shape
     total = p_len + max_new
-    dh = cfg.d_model // cfg.n_heads
+    dh = bcfg.d_model // bcfg.n_heads
     caches = tuple(
-        (jnp.zeros((b, cfg.n_heads, total, dh), jnp.float32),
-         jnp.zeros((b, cfg.n_heads, total, dh), jnp.float32))
-        for _ in range(cfg.n_layers))
+        (jnp.zeros((b, bcfg.n_heads, total, dh), jnp.float32),
+         jnp.zeros((b, bcfg.n_heads, total, dh), jnp.float32))
+        for _ in range(bcfg.n_layers))
     logits, caches = _forward_cached(params, cfg, prompt, caches, 0)
 
     def pick(logits, key):

@@ -85,3 +85,27 @@ def test_sampling_temperature_zero_equals_greedy_and_sampling_varies():
     s2, _ = generate(params, CFG, prompt, 8, temperature=2.0,
                      rng=jax.random.PRNGKey(7))
     assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_moe_cached_decode_matches_moe_forward():
+    """MoE decode: each tick's logits equal the uncached moe_forward on the
+    growing sequence (same routing, same capacity semantics per call)."""
+    from poseidon_tpu.models.moe import MoEConfig, init_moe_params, moe_forward
+    # dropless on both sides: decode forces capacity = per-call tokens,
+    # and the reference gets an explicit capacity covering the full run
+    mcfg = MoEConfig(base=CFG, n_experts=4, capacity=64, aux_weight=0.0)
+    params = init_moe_params(mcfg, jax.random.PRNGKey(8))
+    rs = np.random.RandomState(9)
+    prompt = jnp.asarray(rs.randint(0, CFG.vocab_size, size=(2, 5),
+                                    dtype=np.int32))
+    max_new = 5
+    toks, logits = generate(params, mcfg, prompt, max_new)
+
+    seq = np.asarray(prompt)
+    for t in range(max_new):
+        ref_logits, _ = moe_forward(params, mcfg, jnp.asarray(seq))
+        ref = np.asarray(ref_logits[:, -1])
+        np.testing.assert_allclose(np.asarray(logits[:, t]), ref,
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"step {t}")
+        seq = np.concatenate([seq, np.asarray(toks[:, t:t + 1])], axis=1)
