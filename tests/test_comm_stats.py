@@ -3,7 +3,6 @@
 import numpy as np
 import pytest
 
-import jax
 
 from poseidon_tpu.core.net import Net
 from poseidon_tpu.models import zoo

@@ -1,11 +1,8 @@
-import os
 
 import numpy as np
-import pytest
 
 from poseidon_tpu.data.lmdb_reader import LMDBReader, LMDBWriter
-from poseidon_tpu.data.sources import (ImageListSource, MemorySource,
-                                       SyntheticSource)
+from poseidon_tpu.data.sources import ImageListSource, SyntheticSource
 from poseidon_tpu.data.transformer import DataTransformer
 from poseidon_tpu.data.workload import Shard, contiguous_range, shard_indices
 from poseidon_tpu.proto.messages import TransformationParameter

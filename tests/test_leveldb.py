@@ -1,6 +1,5 @@
 """LevelDB format reader/writer + snappy codec."""
 
-import os
 import struct
 
 import numpy as np
@@ -9,7 +8,7 @@ import pytest
 from poseidon_tpu.data import snappy
 from poseidon_tpu.data.leveldb_reader import (
     LOG_FULL, LevelDBReader, LevelDBWriter, TYPE_DELETION, TYPE_VALUE,
-    crc32c, crc32c_masked, read_log)
+    crc32c, crc32c_masked)
 
 
 def test_crc32c_known_vectors():

@@ -17,7 +17,6 @@ databases exhibit that the repo writer never produces:
 - deletions and overwrites resolved by sequence number across table + WAL
 """
 
-import os
 import struct
 
 import pytest

@@ -7,10 +7,8 @@ train LeNet on the shared synthetic MNIST LMDB, and check both processes agree
 on the final parameters (replicated state implies identical snapshots).
 """
 
-import json
 import os
 import socket
-import subprocess
 import sys
 
 import numpy as np

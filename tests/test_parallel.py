@@ -587,7 +587,6 @@ def test_two_tier_topk_consistent_and_converges(two_tier_mesh, lenet_net,
 
 def test_two_tier_engine_end_to_end(tmp_path_factory, rng_np):
     """Engine + two-tier mesh: the --dcn_slices path."""
-    from poseidon_tpu.proto.messages import SolverParameter as SP
     from poseidon_tpu.runtime.engine import Engine
 
     tmp_path = tmp_path_factory.mktemp("two_tier")

@@ -1,10 +1,8 @@
 """End-to-end runtime tests: Engine driven by real prototxt files, CLI tools."""
 
-import json
 import os
 
 import numpy as np
-import pytest
 
 N_DEV = 8
 
@@ -275,9 +273,7 @@ def test_cli_dataset_tools_roundtrip(tmp_path, capsys):
 def test_extract_features(tmp_path):
     from poseidon_tpu.core.net import Net
     from poseidon_tpu.data.pipeline import BatchPipeline
-    from poseidon_tpu.proto.messages import (LayerParameter,
-                                             MemoryDataParameter,
-                                             load_net_from_string)
+    from poseidon_tpu.proto.messages import load_net_from_string
     from poseidon_tpu.runtime.tools import extract_features
     import jax
 

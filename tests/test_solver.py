@@ -1,13 +1,12 @@
 """Solver update math vs hand-computed Caffe semantics."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from poseidon_tpu.proto.messages import SolverParameter
 from poseidon_tpu.solvers.updates import (
-    SolverState, init_state, learning_rate, make_update_fn)
+    init_state, learning_rate, make_update_fn)
 
 
 def _mults():

@@ -1,8 +1,6 @@
 """DiskStreamer analog (data/stream.py) vs the reference's contract:
 bounded buffering, multi-pass, snappy mode, end-of-stream signaling."""
 
-import os
-import threading
 import time
 
 import numpy as np

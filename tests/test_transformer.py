@@ -1,7 +1,6 @@
 """Transformer LM on a 2-D (data x seq) mesh: DP and SP compose."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
