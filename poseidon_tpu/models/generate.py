@@ -8,11 +8,11 @@ prompt+max_new length, visibility is a position mask, one token per tick),
 no Python control flow on device values.
 
 Prefill and decode share `_block_cached`: prefill runs it once over the
-full prompt (S = P) writing the caches, decode runs it with S = 1 per tick.
-Attention here is plain dot-product against the cache — a single-query
-attend is HBM-bound gather work where the flash kernel's tiling buys
-nothing (the training paths keep routing through
-`ops/pallas_kernels.maybe_flash_attention`)."""
+full prompt (S = P) writing the caches and routes its attention through the
+flash kernel (ordinary causal self-attention — O(S) HBM); decode runs it
+with S = 1 per tick as plain dot-product against the cache, where a
+single-query attend is HBM-bound gather work the kernel's tiling cannot
+improve."""
 
 from __future__ import annotations
 
@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..ops.pallas_kernels import maybe_flash_attention
 from .transformer import (TransformerConfig, _dense, _layer_norm,
                           embed_tokens, ffn_sublayer, lm_head)
 
@@ -42,9 +43,13 @@ def _attend_cached(q, ck, cv, q_pos0):
 
 
 def _block_cached(cfg: TransformerConfig, x, blk, ck, cv, pos0, *,
-                  moe_cfg=None):
+                  moe_cfg=None, prefill=False):
     """One decoder block writing new K/V at ``pos0`` and attending against
-    the (updated) cache. Returns (x_out, ck, cv)."""
+    the (updated) cache. Returns (x_out, ck, cv). ``prefill`` (static)
+    marks the first call, where the cache holds nothing but this call's own
+    keys — attention is then ordinary causal self-attention over the
+    prompt, which routes through the flash kernel (O(S) HBM) instead of
+    materializing the S x T score matrix against the padded cache."""
     b, s, _ = x.shape
     dh = cfg.d_model // cfg.n_heads
     h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
@@ -52,7 +57,10 @@ def _block_cached(cfg: TransformerConfig, x, blk, ck, cv, pos0, *,
     q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))  # (B,H,S,Dh)
     ck = lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos0, axis=2)
     cv = lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos0, axis=2)
-    att = _attend_cached(q, ck, cv, pos0)
+    if prefill:
+        att = maybe_flash_attention(q, k, v, causal=True)
+    else:
+        att = _attend_cached(q, ck, cv, pos0)
     att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)
     x = x + _dense(att, blk["wo"]).astype(x.dtype)
     if moe_cfg is not None:
@@ -77,7 +85,8 @@ def _block_cached(cfg: TransformerConfig, x, blk, ck, cv, pos0, *,
     return ffn_sublayer(x, blk), ck, cv
 
 
-def _forward_cached(params: Dict, cfg, tokens, caches, pos0):
+def _forward_cached(params: Dict, cfg, tokens, caches, pos0, *,
+                    prefill=False):
     """tokens (B, S) starting at absolute position pos0 -> (logits of the
     LAST position (B, V), updated caches). ``cfg`` is a TransformerConfig
     or an MoEConfig — MoE blocks route their FFN through moe_ffn with all
@@ -89,7 +98,7 @@ def _forward_cached(params: Dict, cfg, tokens, caches, pos0):
     for i in range(bcfg.n_layers):
         blk = params[f"block{i}"]
         x, ck, cv = _block_cached(bcfg, x, blk, *caches[i], pos0,
-                                  moe_cfg=moe_cfg)
+                                  moe_cfg=moe_cfg, prefill=prefill)
         new_caches.append((ck, cv))
     return lm_head(params, x)[:, -1], tuple(new_caches)
 
@@ -134,7 +143,8 @@ def _run_impl(params, prompt, rng, temperature, cfg, max_new, sample):
         (jnp.zeros((b, bcfg.n_heads, total, dh), jnp.float32),
          jnp.zeros((b, bcfg.n_heads, total, dh), jnp.float32))
         for _ in range(bcfg.n_layers))
-    logits, caches = _forward_cached(params, cfg, prompt, caches, 0)
+    logits, caches = _forward_cached(params, cfg, prompt, caches, 0,
+                                     prefill=True)
 
     def pick(logits, key):
         if sample:
