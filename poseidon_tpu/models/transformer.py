@@ -258,10 +258,15 @@ def make_fg_ops(tp_axis: str):
 
 
 def tp_block_forward(cfg: TransformerConfig, x: jax.Array, blk: Dict,
-                     f_op, g_op) -> jax.Array:
+                     f_op, g_op, *,
+                     seq_axis: Optional[str] = None) -> jax.Array:
     """One decoder block with tensor-parallel weights: this rank's head
     slices + FFN columns, partial outputs restored by ``g_op``'s psum.
-    Shared by the dp x tp step and the 3-D dp x pp x tp step."""
+    Shared by the dp x tp step and the 3-D dp x pp x tp step. With
+    ``seq_axis``, attention over this rank's heads runs as a ring over
+    that mesh axis (the long-context Megatron + sequence-parallel combo:
+    heads split over tp, K/V chunks rotate over sp — the two compose
+    orthogonally because the ring never crosses heads)."""
     b, s, _ = x.shape
     dh = cfg.d_model // cfg.n_heads
     h = f_op(_layer_norm(x, blk["ln1_g"], blk["ln1_b"]))
@@ -269,7 +274,10 @@ def tp_block_forward(cfg: TransformerConfig, x: jax.Array, blk: Dict,
     hl = qkv.shape[-1] // (3 * dh)        # local heads on this rank
     qkv = qkv.reshape(b, s, hl, 3, dh)
     q, k, v = (qkv[:, :, :, j].swapaxes(1, 2) for j in range(3))
-    att = maybe_flash_attention(q, k, v, causal=True)
+    if seq_axis is None:
+        att = maybe_flash_attention(q, k, v, causal=True)
+    else:
+        att = ring_attention(q, k, v, seq_axis, causal=True)
     att = att.swapaxes(1, 2).reshape(b, s, hl * dh)
     # row-parallel wo: partial product, summed across ranks
     part = _dense(att, blk["wo"])
@@ -328,7 +336,9 @@ def tp_param_specs(params: Dict, tp_axis: str = "model") -> Dict:
 def build_dp_tp_train_step(cfg: TransformerConfig, sp: SolverParameter,
                            mesh: Mesh, params: Dict,
                            data_axis: str = "data",
-                           tp_axis: str = "model", donate: bool = True):
+                           tp_axis: str = "model",
+                           seq_axis: Optional[str] = None,
+                           donate: bool = True):
     """Training step over a 2-D (data x model) mesh — Megatron-style tensor
     parallelism built on XLA collectives instead of hand-written NCCL
     groups (the reference's distributed substrate, SURVEY §2.3; TP itself
@@ -356,43 +366,56 @@ def build_dp_tp_train_step(cfg: TransformerConfig, sp: SolverParameter,
     over ``data_axis``. Pass params through ``to_tp_layout`` first
     (``params`` is used for the spec pytree only — the step still takes
     params positionally); the sharding is published via
-    ``tp_param_specs``."""
+    ``tp_param_specs``.
+
+    With ``seq_axis`` this becomes dp x sp x tp (the long-context 3-D
+    combo): tokens additionally shard over ``seq_axis``, each rank's local
+    heads attend via the sequence ring, and gradients pmean over the seq
+    axis too (it is a second data-like axis for every leaf — tp-sharded
+    leaves are replicated across it, replicated leaves' f/g-summed grads
+    differ per seq shard)."""
     specs = tp_param_specs(params, tp_axis)
     _check_tp_divisibility(cfg, mesh, tp_axis)
     f_op, g_op = make_fg_ops(tp_axis)
 
     def block_tp(x, blk):
-        return tp_block_forward(cfg, x, blk, f_op, g_op)
+        return tp_block_forward(cfg, x, blk, f_op, g_op, seq_axis=seq_axis)
 
-    def forward_tp(p, tokens):
-        b, s = tokens.shape
-        x = p["embed"]["w"][tokens]
-        x = x + p["pos"]["w"][jnp.arange(s)]
+    def forward_tp(p, tokens, pos_offset):
+        x = embed_tokens(p, tokens, pos_offset)
         blk_fn = jax.checkpoint(block_tp) if cfg.remat else block_tp
         for i in range(cfg.n_layers):
             x = blk_fn(x, p[f"block{i}"])
-        x = _layer_norm(x, p["ln_f"]["g"], p["ln_f"]["b"])
-        return _dense(x, p["head"]["w"]).astype(jnp.float32)
+        return lm_head(p, x)
 
     def device_step(p, state: SolverState, tokens, targets, rng):
+        if seq_axis is None:
+            pos_offset = 0
+        else:
+            pos_offset = lax.axis_index(seq_axis) * tokens.shape[1]
+
         def loss_fn(pp):
-            return lm_loss(forward_tp(pp, tokens), targets)
+            return lm_loss(forward_tp(pp, tokens, pos_offset), targets)
 
         loss, grads = jax.value_and_grad(loss_fn)(p)
         # replicated leaves' grads are already full on every tp rank (the
         # f/g operators did the cross-rank sums in backward); sharded
-        # leaves' grads are complete locally — only the data axis remains
-        grads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, data_axis), grads)
+        # leaves' grads are complete locally — the data-like axes remain
+        def sync(g):
+            g = lax.pmean(g, data_axis)
+            return g if seq_axis is None else lax.pmean(g, seq_axis)
+        grads = jax.tree_util.tree_map(sync, grads)
         upd = make_update_fn(sp, transformer_mults(p))
         new_params, new_state = upd(p, grads, state)
-        metrics = {"loss": lax.pmean(loss, data_axis)}
+        metrics = {"loss": sync(loss)}
         return new_params, new_state, metrics
 
+    tok_spec = (P(data_axis) if seq_axis is None
+                else P(data_axis, seq_axis))
     state_spec = SolverState(it=P(), history=specs)
     sharded = jax.shard_map(
         device_step, mesh=mesh,
-        in_specs=(specs, state_spec, P(data_axis), P(data_axis), P()),
+        in_specs=(specs, state_spec, tok_spec, tok_spec, P()),
         out_specs=(specs, state_spec, P()),
         check_vma=False)
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
