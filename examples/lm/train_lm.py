@@ -72,11 +72,17 @@ def main() -> None:
     from poseidon_tpu.models import transformer as tfm
     from poseidon_tpu.parallel.mesh import make_mesh
     from poseidon_tpu.proto.messages import SolverParameter
+    from poseidon_tpu.runtime.cluster import init_distributed
     from poseidon_tpu.solvers.updates import init_state
 
     if args.bf16:
         config.set_policy(compute_dtype=jnp.bfloat16)
 
+    # joins the jax.distributed cluster when launched multi-process (the
+    # scripts/launch.py env contract); no-op standalone. The mesh below
+    # then spans every process's devices and the step's collectives ride
+    # the real transport.
+    rank = init_distributed()
     n_dev = jax.device_count()
     if args.par_axis:
         par_ax = args.par_axis
@@ -97,7 +103,9 @@ def main() -> None:
             + (f" and --seq {args.seq} by {par_ax}"
                if args.mode == "sp" else ""))
     mesh = make_mesh(axes=("data", axis_name), shape=(data_ax, par_ax))
-    print(f"mesh: data={data_ax} x {axis_name}={par_ax} ({n_dev} devices)")
+    if rank == 0:
+        print(f"mesh: data={data_ax} x {axis_name}={par_ax} "
+              f"({n_dev} devices)")
 
     cfg = tfm.TransformerConfig(
         vocab_size=256, d_model=args.d_model, n_heads=args.n_heads,
@@ -151,6 +159,12 @@ def main() -> None:
                 jnp.asarray(toks[:, 1:].astype(np.int32)))
 
     state = init_state(params)
+    if jax.process_count() > 1:
+        # host-numpy leaves are the multi-process placement contract:
+        # identical on every process, pjit shards/replicates them per the
+        # step's in_specs (sharded jnp singles would be process-local)
+        params = jax.tree_util.tree_map(np.asarray, params)
+        state = jax.tree_util.tree_map(np.asarray, state)
     t0 = steps_timed = 0
     for it in range(1, args.steps + 1):
         tokens, targets = sample_batch()
@@ -159,18 +173,22 @@ def main() -> None:
         if it == 1:
             # first step is compile-dominated: report it, then restart the
             # throughput clock so tok/s reflects steady state
-            print(f"step {it:5d}  loss {float(metrics['loss']):.4f}  "
-                  f"(compiling)", flush=True)
+            if rank == 0:
+                print(f"step {it:5d}  loss {float(metrics['loss']):.4f}  "
+                      f"(compiling)", flush=True)
             t0, steps_timed = time.perf_counter(), 0
             continue
         steps_timed += 1
-        if it % args.display == 0:
+        if it % args.display == 0 and rank == 0:
             dt = time.perf_counter() - t0
             tps = steps_timed * args.batch * args.seq / dt
             print(f"step {it:5d}  loss {float(metrics['loss']):.4f}  "
                   f"{tps:,.0f} tok/s", flush=True)
 
-    if args.generate:
+    if args.generate and jax.process_count() > 1:
+        if rank == 0:
+            print("--generate: single-process only; skipping")
+    elif args.generate:
         if args.generate > cfg.max_seq - 8:
             raise SystemExit(f"--generate {args.generate} must be < "
                              f"max_seq - 8 = {cfg.max_seq - 8} (learned "

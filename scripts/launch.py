@@ -29,10 +29,13 @@ sys.path.insert(0, REPO)
 
 
 def launch_local(n_proc: int, devices: int, port: int, train_args,
-                 capture: bool = False) -> int:
+                 capture: bool = False, program=None) -> int:
     """Spawn n_proc local training processes. Any '{proc_id}' in
     train_args is replaced per process (e.g. per-rank output dirs).
-    With capture=True, returns (rc, [stdout bytes]) for tests."""
+    With capture=True, returns (rc, [stdout bytes]) for tests.
+    ``program`` overrides the argv prefix (default: the poseidon_tpu CLI)
+    so other entry points — e.g. examples/lm/train_lm.py — run under the
+    same multi-process env contract without copying it."""
     procs = []
     for pid in range(n_proc):
         env = dict(os.environ)
@@ -45,19 +48,27 @@ def launch_local(n_proc: int, devices: int, port: int, train_args,
         env["POSEIDON_NUM_PROCS"] = str(n_proc)
         env["POSEIDON_PROC_ID"] = str(pid)
         sub = [a.replace("{proc_id}", str(pid)) for a in train_args]
-        cmd = [sys.executable, "-m", "poseidon_tpu"] + sub
+        cmd = (program or [sys.executable, "-m", "poseidon_tpu"]) + sub
         kw = dict(stdout=subprocess.PIPE, stderr=subprocess.STDOUT) \
             if capture else {}
         procs.append(subprocess.Popen(cmd, env=env, cwd=REPO, **kw))
     rc = 0
     logs = []
-    for p in procs:
-        if capture:
-            out, _ = p.communicate(timeout=600)
-            logs.append(out)
-        else:
-            p.wait()
-        rc |= p.returncode
+    try:
+        for p in procs:
+            if capture:
+                out, _ = p.communicate(timeout=600)
+                logs.append(out)
+            else:
+                p.wait()
+            rc |= p.returncode
+    finally:
+        # a dead rank leaves the others blocked in rendezvous/collectives;
+        # never leak them past the launcher
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     return (rc, logs) if capture else rc
 
 

@@ -136,3 +136,29 @@ def test_two_process_ssp_two_tier_wire(tmp_path):
     assert local_keys, sorted(snaps[0].files)[:8]
     for k in local_keys:
         assert snaps[0][k].shape[0] == 2, (k, snaps[0][k].shape)
+
+
+def test_two_process_lm_tensor_parallel():
+    """The LM family over the REAL distributed control plane: 2 processes
+    x 4 devices run dp x tp with mesh data=1 x model=8, so the Megatron
+    f/g psums themselves cross the process boundary (a data=2 x model=4
+    mesh would keep every model group inside one process). Loss must fall
+    and both ranks must exit clean. Launched through launch_local — the
+    one owner of the multi-process env contract."""
+    import re
+    scripts = os.path.join(REPO, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import launch
+    rc, raw_logs = launch.launch_local(
+        2, 4, _free_port(),
+        ["--mode", "tp", "--data_axis", "1", "--par_axis", "8",
+         "--steps", "20", "--seq", "32", "--d_model", "32",
+         "--n_heads", "8", "--display", "19", "--batch", "8"],
+        capture=True,
+        program=[sys.executable,
+                 os.path.join(REPO, "examples/lm/train_lm.py")])
+    logs = [b.decode() for b in raw_logs]
+    assert rc == 0, logs[0][-2000:] + logs[1][-2000:]
+    losses = [float(m) for m in re.findall(r"loss (\d+\.\d+)", logs[0])]
+    assert len(losses) >= 2 and losses[-1] < losses[0], losses
