@@ -153,13 +153,19 @@ class BatchPipeline:
             # mean_value/no-mean configs move on-device
             if (self._want_device_transform and not tp.mean_file
                     and self.native.supports_u8() and self._n_records):
-                # probe one record: float_data-backed Datums cannot ship as
-                # uint8 (rc=-4) — fall back to the host f32 path instead of
-                # crashing the prefetch worker on the first real batch
-                # (IndexError covers a DB that vanished between len() and
-                # here; the empty-DB case is excluded by _n_records above)
+                # probe a spread of records: float_data-backed Datums cannot
+                # ship as uint8 (rc=-4), and a MIXED byte/float DB detected
+                # here gets the host f32 path for the whole pipeline — the
+                # only moment the wire contract can still change (once the
+                # step compiles against the uint8 spec, a mid-epoch float
+                # record can only be re-quantized, lossily). IndexError
+                # covers a DB that vanished between len() and here; the
+                # empty-DB case is excluded by _n_records above.
+                n = self._n_records
+                probe = np.unique(np.linspace(0, n - 1, num=min(n, 8),
+                                              dtype=np.int64))
                 try:
-                    self.native.batch_u8(np.zeros(1, np.int64))
+                    self.native.batch_u8(probe)
                     self._u8 = True
                 except (IOError, IndexError):
                     self._u8 = False

@@ -216,13 +216,15 @@ def _chained_sync_tap(axes: tuple, reduce: str, wire: Optional[str] = None):
     the reference's per-layer overlap structure (solver.cpp:419-449) at
     bucket granularity (CommConfig.dwbp_bucket_mb).
 
-    The gate is a real data dependency (``where(tok < inf, g, 0)``), not an
-    ``optimization_barrier``: barriers are stripped before XLA's all-reduce
-    combiner runs (measured on the cpu backend — the barrier-chained program
-    still compiled to ONE merged all-reduce), while a select on a runtime
-    scalar cannot be folded. The gate is the identity whenever the token is
-    finite; a non-finite token requires a non-finite psum result upstream,
-    i.e. training is already dead."""
+    The gate is a real data dependency (``where(tok < inf, g, nan)``), not
+    an ``optimization_barrier``: barriers are stripped before XLA's
+    all-reduce combiner runs (measured on the cpu backend — the
+    barrier-chained program still compiled to ONE merged all-reduce), while
+    a select on a runtime scalar cannot be folded. The gate is the identity
+    whenever the token is finite; a non-finite token means a non-finite
+    psum result upstream, and the gate propagates that NaN into every
+    earlier bucket so the divergence stays fail-loud instead of collapsing
+    into silent zero gradients."""
 
     @jax.custom_vjp
     def tap(w, tok):
@@ -233,7 +235,7 @@ def _chained_sync_tap(axes: tuple, reduce: str, wire: Optional[str] = None):
 
     def bwd(_, cts):
         g, g_tok = cts
-        gated = jnp.where(g_tok < jnp.inf, g, jnp.zeros_like(g))
+        gated = jnp.where(g_tok < jnp.inf, g, jnp.full_like(g, jnp.nan))
         s = wire_psum(gated, axes, reduce, wire)
         # outgoing token depends on the psum result; its VALUE is never used
         # numerically (only the dependency), so any finite combine works

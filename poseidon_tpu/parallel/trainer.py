@@ -191,6 +191,21 @@ def build_train_step(
                 f"replicated; use build_ssp_train_step for per-device "
                 f"divergent parameters")
 
+    if iter_size > 1:
+        sfb_layers = [l for l in net.param_defs
+                      if comm.strategy_for(l) == SFB]
+        if sfb_layers or comm.dwbp_bucket_mb is not None:
+            from ..runtime.metrics import log
+            what = []
+            if sfb_layers:
+                what.append(f"SFB layers {sfb_layers}")
+            if comm.dwbp_bucket_mb is not None:
+                what.append(f"dwbp_bucket_mb={comm.dwbp_bucket_mb}")
+            log(f"WARNING: iter_size={iter_size} accumulates gradients "
+                f"before one dense post-accumulation psum; per-backward "
+                f"comm strategies ({', '.join(what)}) do not apply to the "
+                f"accumulated step")
+
     topk_layers = [l for l in net.param_defs
                    if comm.strategy_for(l) == TOPK]
     fused_layers = [l for l in net.param_defs
@@ -451,8 +466,8 @@ class SSPState(NamedTuple):
     anchor_params: Dict  # leaves: (*shape,), replicated
     it: jax.Array
     comm_error: Dict     # TOPK residuals: (n_dev, *shape), sharded on axis 0
-    adarev_server: Dict = {}  # z/zmax accumulators, replicated
-    adarev_gsum: Dict = {}    # (n_groups, *shape) raw grad sums, sharded
+    adarev_server: Dict      # z/zmax accumulators, replicated ({} unless on)
+    adarev_gsum: Dict        # (n_groups, *shape) raw grad sums, sharded
 
 
 def build_ssp_train_step(
