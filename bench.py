@@ -37,8 +37,12 @@ import numpy as np
 
 BASELINE_IMAGES_PER_SEC_PER_DEVICE = 200.0   # PMLS-Caffe AlexNet on one K20
 GOOGLENET_BASELINE_PER_DEVICE = 120.0        # ~4x single-GPU Caffe, 8 workers
-LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "BENCH_last_good.json")
+_REPO = os.path.dirname(os.path.abspath(__file__))
+LAST_GOOD_PATH = os.path.join(_REPO, "BENCH_last_good.json")
+# Every completed section checkpoints here, so a mid-run tunnel flap (or the
+# driver's SIGKILL at its patience limit — round 3 lost a whole window to a
+# 1200 s rc -9) still leaves the finished sections' numbers on disk.
+PARTIAL_PATH = os.path.join(_REPO, "evidence", "bench_partial.json")
 
 # Peak bf16 FLOPs/s per chip by device kind (public specs); fallback is v5e.
 PEAK_FLOPS = {
@@ -72,11 +76,40 @@ def fail(error: str, probe: dict | None = None,
     if os.path.exists(LAST_GOOD_PATH):
         try:
             with open(LAST_GOOD_PATH) as f:
-                payload["last_good"] = json.load(f)
+                lg = json.load(f)
+            # a carried-forward number must SAY it is carried forward: the
+            # round-4 verdict caught last_good passing silently as if fresh
+            lg["stale_carryover"] = True
+            if "recorded_at" in lg:
+                lg["age_hours"] = round(
+                    (time.time() - lg["recorded_at"]) / 3600.0, 1)
+                print(f"[bench] FAILED ({error}); last_good below is "
+                      f"{lg['age_hours']}h old, NOT a fresh measurement",
+                      file=sys.stderr, flush=True)
+            payload["last_good"] = lg
         except Exception:
             pass
     emit(payload)
     sys.exit(1)
+
+
+def checkpoint_partial(extras: dict, section: str) -> None:
+    """Persist completed sections' numbers immediately (atomic rename), so
+    the slowest section hanging cannot erase the ones that finished."""
+    try:
+        os.makedirs(os.path.dirname(PARTIAL_PATH), exist_ok=True)
+        doc = {"sections_done": extras.get("_sections_done", []) + [section],
+               "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+               **{k: v for k, v in extras.items() if not k.startswith("_")}}
+        extras["_sections_done"] = doc["sections_done"]
+        tmp = PARTIAL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, PARTIAL_PATH)
+    except Exception as e:  # noqa: BLE001 — checkpointing must never kill a run
+        print(f"[bench] partial checkpoint failed: {e}", file=sys.stderr,
+              flush=True)
 
 
 def probe_backend(timeout_s: float, attempts: int) -> dict:
@@ -354,6 +387,15 @@ def main() -> None:
         differencing_ok = dev > 0
         if differencing_ok:
             overhead = max(disp_a - scan * dev, 0.0)
+            # plausibility cross-check against the independently measured
+            # tiny-dispatch round-trip: an "overhead" orders of magnitude
+            # above that floor (round 3's googlenet_dispatch_overhead_ms:
+            # 16368) means the K-vs-2K difference under-estimated the device
+            # step — flag it so the derived img/s is read with suspicion
+            floor_s = extras.get("dispatch_roundtrip_floor_ms", 0.0) / 1e3
+            if overhead > max(1.0, 20.0 * floor_s):
+                extras.setdefault("dispatch_overhead_implausible",
+                                  {})[model] = round(overhead, 3)
         else:                # noise swamped the difference; fall back
             dev = step_a     # wall-based: still contains overhead/K
             # the measured tiny-dispatch round-trip is the FLOOR of the
@@ -411,6 +453,7 @@ def main() -> None:
             extras["alexnet_step_flops_per_device"] = flops
         extras["alexnet_step_ms"] = round(step_s * 1e3, 3)
         extras["alexnet_loss"] = float(np.asarray(m["loss"]).ravel()[-1])
+        checkpoint_partial(extras, "alexnet")
 
         def _device_est(wall_per_step_s, tag):
             """Per-step device time for a sibling program: same-K wall minus
@@ -439,6 +482,7 @@ def main() -> None:
             extras["dwbp_overlap_speedup"] = round(fused_s / step_s, 4)
             extras["fused_sync_step_ms"] = round(fused_s * 1e3, 3)
             del ts2, p2, s2, b2
+            checkpoint_partial(extras, "dwbp_ab")
 
         # ---- Conv layout A/B: NCHW vs internal NHWC -----------------------
         if os.environ.get("POSEIDON_BENCH_LAYOUT_AB", "1") == "1" and \
@@ -453,6 +497,7 @@ def main() -> None:
             extras["nhwc_step_ms"] = round(nhwc_s * 1e3, 3)
             extras["nhwc_speedup"] = round(step_s / nhwc_s, 4)
             del ts3, p3, s3, b3
+            checkpoint_partial(extras, "layout_ab")
 
         # ---- Stem space-to-depth A/B: conv1 uses 3 of 128 MXU lanes -------
         if os.environ.get("POSEIDON_BENCH_S2D_AB", "1") == "1" and \
@@ -467,6 +512,7 @@ def main() -> None:
             extras["s2d_step_ms"] = round(s2d_s * 1e3, 3)
             extras["s2d_speedup"] = round(step_s / s2d_s, 4)
             del ts5, p5, s5, b5
+            checkpoint_partial(extras, "s2d_ab")
 
         # ---- TOPK selection cost at fc6 scale: global vs blocked ----------
         if os.environ.get("POSEIDON_BENCH_TOPK",
@@ -498,6 +544,7 @@ def main() -> None:
                 extras["topk_global_ms"] /
                 max(extras["topk_blocked_ms"], 1e-9), 2)
             del g, err0
+            checkpoint_partial(extras, "topk")
 
         # ---- Transformer LM (long-context flagship; beyond-reference) -----
         if os.environ.get("POSEIDON_BENCH_LM",
@@ -545,6 +592,7 @@ def main() -> None:
             extras["lm_seq"] = lm_seq
             extras["lm_loss"] = float(lm_m["loss"])
             del lp, ls
+            checkpoint_partial(extras, "lm")
 
         # ---- GoogLeNet ----------------------------------------------------
         if with_googlenet and budget_left("googlenet"):
@@ -567,6 +615,7 @@ def main() -> None:
                 np.asarray(mg["loss"]).ravel()[-1])
             if gflops:
                 extras["googlenet_mfu"] = round(gflops / g_step_s / peak, 4)
+            checkpoint_partial(extras, "googlenet")
     except Exception as e:  # noqa: BLE001
         import traceback
         fail(f"{type(e).__name__}: {e} | "
@@ -580,7 +629,7 @@ def main() -> None:
         "unit": "images/s/chip",
         "vs_baseline": round(per_device / BASELINE_IMAGES_PER_SEC_PER_DEVICE,
                              3),
-        **extras,
+        **{k: v for k, v in extras.items() if not k.startswith("_")},
     }
     if not cpu_ok:
         try:
