@@ -1,0 +1,115 @@
+"""Static layout test: NHWC boundary transposes must cancel in the HLO.
+
+Round-4 commit ef62b27 extended the channels-last policy to pooling/LRN so
+the conv->relu->lrn->pool->conv chain stays NHWC end to end; the claim that
+"boundary transposes are exact inverses and cancel in XLA" was never pinned
+by a test, and the only hardware A/B (round 3, pre-fix) measured 0.53x —
+i.e. the transposes did NOT cancel when pool/LRN stayed NCHW. This applies
+the test_hlo_comm.py pattern (assert on the compiled program, not on our
+intent) to layout: count `transpose` ops in the optimized HLO of the chain
+under both layout policies. A future regression that strands a layout
+change mid-chain reappears as a transpose-count jump, caught on CPU.
+
+Reference anchor: the cuDNN NCHW-native layers this policy replaces
+(src/caffe/layers/cudnn_conv_layer.cpp); the TPU-first design instead picks
+XLA's preferred channels-last layout and keeps the public interface NCHW.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu import config
+from poseidon_tpu.ops import nn
+
+B, C, H, W = 4, 3, 31, 31
+C1, C2 = 16, 32
+
+
+def _chain(x, w1, b1, w2, b2):
+    """AlexNet's stem order: conv -> relu -> lrn -> pool -> conv."""
+    y = nn.conv2d(x, w1, b1, stride=(2, 2), pad=(1, 1))
+    y = jax.nn.relu(y)
+    y = nn.lrn_across_channels(y, local_size=5, alpha=1e-4, beta=0.75)
+    y = nn.max_pool(y, kernel=(3, 3), stride=(2, 2), pad=(0, 0))
+    return nn.conv2d(y, w2, b2, stride=(1, 1), pad=(1, 1))
+
+
+def _inputs():
+    rs = np.random.RandomState(0)
+    return (jnp.asarray(rs.randn(B, C, H, W).astype(np.float32)),
+            jnp.asarray(rs.randn(C1, C, 3, 3).astype(np.float32)),
+            jnp.asarray(rs.randn(C1).astype(np.float32)),
+            jnp.asarray(rs.randn(C2, C1, 3, 3).astype(np.float32)),
+            jnp.asarray(rs.randn(C2).astype(np.float32)))
+
+
+def _n_transposes(fn, *args, layout: str) -> int:
+    with config.policy_scope(conv_layout=layout):
+        hlo = jax.jit(fn).lower(*args).compile().as_text()
+    # count transpose OPS (incl. inside fusion bodies), not the word in
+    # metadata: an HLO instruction line is `%x = f32[...]{...} transpose(`
+    return len(re.findall(r"= [a-z0-9\[\]{},]+ transpose\(", hlo))
+
+
+def test_nhwc_forward_boundary_transposes_cancel():
+    """Forward chain: every op-boundary transpose pair between consecutive
+    channels-last ops must cancel, leaving only the chain's entry/exit
+    (<= 2 more than the NCHW build, which needs none of them)."""
+    args = _inputs()
+    n_nchw = _n_transposes(_chain, *args, layout="NCHW")
+    n_nhwc = _n_transposes(_chain, *args, layout="NHWC")
+    # 5 channels-last ops x 2 boundary transposes each = 10 written; all
+    # interior pairs must cancel. Allow entry + exit only.
+    assert n_nhwc <= n_nchw + 2, (
+        f"NHWC chain keeps {n_nhwc} transposes vs {n_nchw} for NCHW — "
+        f"boundary transposes are NOT cancelling (ef62b27 regression: some "
+        f"op in the chain fell back to NCHW mid-stream)")
+
+
+def test_nhwc_backward_boundary_transposes_cancel():
+    """Same property through the VJP: the cotangent chain re-traverses every
+    boundary, so a stranded mid-chain layout change doubles up here."""
+    args = _inputs()
+
+    def loss(x, w1, b1, w2, b2):
+        return jnp.sum(_chain(x, w1, b1, w2, b2) ** 2)
+
+    g = jax.grad(loss, argnums=(1, 2, 3, 4))
+    n_nchw = _n_transposes(g, *args, layout="NCHW")
+    n_nhwc = _n_transposes(g, *args, layout="NHWC")
+    # forward entry/exit + their backward mirrors; weight-grad convs may
+    # each keep one layout change that has no inverse partner
+    assert n_nhwc <= n_nchw + 6, (
+        f"NHWC backward keeps {n_nhwc} transposes vs {n_nchw} for NCHW")
+
+
+def test_nhwc_chain_is_channels_last_inside():
+    """The convolutions must actually RUN channels-last under the policy:
+    the optimized HLO's convolution ops carry f32[N,H,W,C]-shaped operands
+    (minor-most channels), not just reordered metadata."""
+    args = _inputs()
+    with config.policy_scope(conv_layout="NHWC"):
+        hlo = jax.jit(_chain).lower(*args).compile().as_text()
+    conv_lines = [ln for ln in hlo.splitlines() if "convolution" in ln
+                  and "dim_labels" in ln]
+    assert conv_lines, "no convolution ops in compiled chain"
+    for ln in conv_lines:
+        m = re.search(r"dim_labels=([a-z0-9]+_[a-z0-9]+->[a-z0-9]+)", ln)
+        if m:
+            assert m.group(1).startswith("b01f"), (
+                f"conv not channels-last under NHWC policy: {ln.strip()}")
+
+
+def test_nhwc_numerics_match_nchw():
+    """Layout is a performance policy, never a numerics change."""
+    args = _inputs()
+    with config.policy_scope(conv_layout="NCHW"):
+        ref = jax.jit(_chain)(*args)
+    with config.policy_scope(conv_layout="NHWC"):
+        out = jax.jit(_chain)(*args)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)
