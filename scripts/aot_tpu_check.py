@@ -1,0 +1,460 @@
+"""AOT-compile the product for a REAL TPU target — no tunnel required.
+
+Round-5 discovery: the local `libtpu` can compile for an abstract v5e
+topology via ``jax.experimental.topologies.get_topology_desc`` with zero
+TPU hardware. That turns three formerly hardware-gated items into static
+evidence the moment this script runs:
+
+1. ``pallas_mosaic`` — Mosaic-lowers every Pallas kernel (flash fwd/bwd in
+   f32/bf16, fused LRN fwd/bwd) with the SAME compiler the chip runs. The
+   round-3 on-TPU failures were Mosaic *lowering* errors + numerics; the
+   lowering half is now checked off-tunnel on every run (numerics still
+   need the chip).
+2. ``dwbp`` — compiles the bucketed / per-blob / fused AlexNet step for a
+   v5e-8 mesh and counts async-start/done collective pairs and the compute
+   ops scheduled INSIDE each async window in the latency-hiding-scheduled
+   module. This is the TPU-target overlap proof the round-4 verdict asked
+   for (reference mechanism: solver.cpp:419-449 — per-layer gradient comm
+   overlapping the remaining backward).
+3. ``lm_modes`` — compiles each LM parallelism mode (dp x sp / tp / pp /
+   ep / 3-D) for v5e-8 and records the collective schedule per mode: the
+   per-mode comm table the LM family's performance identity needs.
+4. ``nhwc`` — transpose counts for the conv->lrn->pool->conv stem chain
+   under both layout policies, on the TPU compiler itself (the CPU-level
+   version of this is tests/test_layout_hlo.py).
+
+Each section writes ``evidence/aot_tpu/<section>.json`` immediately
+(atomic), so a slow compile dying cannot erase earlier sections. Prints a
+one-line JSON summary at the end. ``--sections a,b`` runs a subset.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Pin the host platform BEFORE jax imports: the axon plugin would otherwise
+# try the tunnel (and hang when it is down); AOT needs no devices at all.
+# The axon sitecustomize registers its backend at interpreter START when
+# PALLAS_AXON_POOL_IPS is set — env edits here come too late, so re-exec
+# once with a clean environment instead.
+# Async all-reduce fusion is OFF by default in libtpu; it is the TPU
+# backend's mechanism for overlapping gradient all-reduces with backward
+# compute (the DWBP story), so the evidence compiles run with it on. The
+# flag must be present before libtpu loads — part of the re-exec env.
+ASYNC_FLAGS = ("--xla_tpu_enable_async_collective_fusion_fuse_all_reduce"
+               "=true --xla_enable_async_all_reduce=true")
+if os.environ.get("PALLAS_AXON_POOL_IPS") or \
+        "xla_enable_async_all_reduce" not in \
+        os.environ.get("LIBTPU_INIT_ARGS", ""):
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LIBTPU_INIT_ARGS"] = (env.get("LIBTPU_INIT_ARGS", "") + " " +
+                               ASYNC_FLAGS).strip()
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5e-8")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EVID = os.path.join(REPO, "evidence", "aot_tpu")
+
+TOPOLOGY = "v5e:2x4"          # 8 abstract v5e chips
+
+
+def _stamp() -> dict:
+    import subprocess
+    s = {"captured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime()),
+         "topology": TOPOLOGY, "mode": "aot-compile-only"}
+    try:
+        s["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=REPO, timeout=30).stdout.strip()
+        s["dirty"] = bool(subprocess.run(
+            ["git", "status", "--porcelain", "-uno"], capture_output=True,
+            text=True, cwd=REPO, timeout=30).stdout.strip())
+    except Exception:  # noqa: BLE001
+        pass
+    return s
+
+
+STAMP: dict = {}
+
+
+def _write(section: str, doc: dict) -> None:
+    os.makedirs(EVID, exist_ok=True)
+    doc["stamp"] = STAMP
+    tmp = os.path.join(EVID, f"{section}.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, os.path.join(EVID, f"{section}.json"))
+    print(f"[aot] wrote {section}.json", flush=True)
+
+
+def _topology():
+    """libtpu allows ONE process at a time (multi-process lockfile under
+    /tmp); a concurrent AOT run or a live TPU client makes plugin init
+    abort — retry with backoff instead of dying at t=0."""
+    from jax.experimental import topologies
+    last = None
+    for attempt in range(10):
+        try:
+            return topologies.get_topology_desc(TOPOLOGY, platform="tpu")
+        except Exception as e:  # noqa: BLE001
+            last = e
+            if "lockfile" not in str(e):
+                raise
+            print(f"[aot] libtpu lockfile busy (attempt {attempt + 1}); "
+                  f"waiting 30s", flush=True)
+            time.sleep(30)
+    raise last
+
+
+def _mesh(topo, axes, shape):
+    import numpy as np
+    from jax.sharding import Mesh
+    n = int(np.prod(shape))
+    return Mesh(np.array(topo.devices[:n]).reshape(shape), axes)
+
+
+def _compile(fn, *args, **jit_kw):
+    import jax
+    return jax.jit(fn, **jit_kw).lower(*args).compile().as_text()
+
+
+# ------------------------------------------------------------------------- #
+# 1. Pallas kernels through the real Mosaic pipeline
+# ------------------------------------------------------------------------- #
+
+def section_pallas_mosaic(topo) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from poseidon_tpu.ops.pallas_kernels import flash_attention, lrn_fused
+
+    m1 = _mesh(topo, ("x",), (1,))
+    sh = NamedSharding(m1, P())
+
+    def aval(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+
+    cases = {}
+
+    def check(name, fn, *avals):
+        t0 = time.time()
+        try:
+            txt = _compile(fn, *avals)
+            cases[name] = {"ok": True,
+                           "tpu_custom_calls": txt.count("tpu_custom_call"),
+                           "seconds": round(time.time() - t0, 1)}
+        except Exception as e:  # noqa: BLE001
+            cases[name] = {"ok": False,
+                           "error": f"{type(e).__name__}: "
+                                    f"{str(e)[:600]}",
+                           "seconds": round(time.time() - t0, 1)}
+        print(f"[aot]   {name}: "
+              f"{'ok' if cases[name]['ok'] else 'FAIL'}", flush=True)
+
+    B, H, D = 2, 4, 64
+    for dt, tag in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
+        for S in (1024, 4096):
+            q = aval((B, H, S, D), dt)
+            check(f"flash_fwd_{tag}_s{S}",
+                  lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                  interpret=False), q, q, q)
+
+            def fwd_bwd(q, k, v):
+                f = lambda a, b, c: flash_attention(
+                    a, b, c, causal=True, interpret=False).sum()
+                return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+            check(f"flash_bwd_{tag}_s{S}", fwd_bwd, q, q, q)
+
+    x = aval((8, 96, 27, 27), jnp.float32)
+    check("lrn_fused_fwd",
+          lambda x: lrn_fused(x, 5, 1e-4, 0.75, 1.0, interpret=False), x)
+    check("lrn_fused_bwd",
+          lambda x: jax.grad(lambda y: lrn_fused(
+              y, 5, 1e-4, 0.75, 1.0, interpret=False).sum())(x), x)
+
+    n_fail = sum(1 for c in cases.values() if not c["ok"])
+    return {"cases": cases, "n_cases": len(cases), "n_fail": n_fail,
+            "ok": n_fail == 0}
+
+
+# ------------------------------------------------------------------------- #
+# 2. DWBP overlap on the TPU target: async pairs in the scheduled module
+# ------------------------------------------------------------------------- #
+
+def _alexnet_step(mesh, comm):
+    import jax
+    import jax.numpy as jnp
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import build_train_step, init_train_state
+    from poseidon_tpu.proto.messages import SolverParameter
+
+    net_param = zoo.alexnet(num_classes=64, with_accuracy=False)
+    net = Net(net_param, phase="TRAIN",
+              source_shapes={"data": (8, 3, 67, 67), "label": (8,)})
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    ts = build_train_step(net, sp, mesh, comm, donate=False)
+    params = net.init(jax.random.PRNGKey(0))
+    state = init_train_state(params, comm, 8)
+    batch = {"data": jnp.zeros((64, 3, 67, 67), jnp.float32),
+             "label": jnp.zeros((64,), jnp.int32)}
+    return (ts.lowerable or ts.step), (params, state, batch,
+                                       jax.random.PRNGKey(1))
+
+
+def section_dwbp(topo) -> dict:
+    from analyze_schedule import (analyze_module, analyze_tpu_async_fusion,
+                                  analyze_tpu_schedule)
+    from poseidon_tpu.parallel import CommConfig
+
+    mesh = _mesh(topo, ("data",), (8,))
+    out = {"libtpu_flags": ASYNC_FLAGS}
+    for mode in ("bucketed", "per_blob", "fused"):
+        if mode == "bucketed":
+            comm = CommConfig(dwbp_bucket_mb=4.0)
+        elif mode == "per_blob":
+            comm = CommConfig(dwbp_bucket_mb=0)
+        else:
+            import jax
+            from poseidon_tpu.core.net import Net
+            from poseidon_tpu.models import zoo
+            from poseidon_tpu.parallel.strategies import DENSE_FUSED
+            net = Net(zoo.alexnet(num_classes=64, with_accuracy=False),
+                      phase="TRAIN",
+                      source_shapes={"data": (8, 3, 67, 67), "label": (8,)})
+            p = net.init(jax.random.PRNGKey(0))
+            comm = CommConfig(layer_strategies={n: DENSE_FUSED for n in p})
+        t0 = time.time()
+        lowerable, args = _alexnet_step(mesh, comm)
+        txt = lowerable.lower(*args).compile().as_text()
+        r = analyze_module(txt)
+        r["async_fusion"] = analyze_tpu_async_fusion(txt)
+        sched = analyze_tpu_schedule(txt)
+        r["tpu_cycles"] = {k: sched[k] for k in
+                           ("n_all_reduce", "total_estimated_cycles",
+                            "hideable_cycles_total")}
+        r["compile_seconds"] = round(time.time() - t0, 1)
+        out[mode] = r
+        print(f"[aot]   dwbp/{mode}: {r['n_collectives']} collectives, "
+              f"{r['async_fusion']['n_async_collective_fusions']} async "
+              f"fusions, {r['async_fusion']['total_compute_ops_overlapped']} "
+              f"compute ops overlapped", flush=True)
+    b, f = out["bucketed"]["async_fusion"], out["fused"]["async_fusion"]
+    out["verdict"] = {
+        "bucketed_async_collective_fusions": b["n_async_collective_fusions"],
+        "bucketed_compute_ops_overlapped":
+            b["total_compute_ops_overlapped"],
+        "fused_async_collective_fusions": f["n_async_collective_fusions"],
+        # the DWBP claim on the TPU target: bucketed mid-backward
+        # collectives get fused with remaining backward compute; the
+        # single end-of-backward sync has nothing to hide behind
+        "overlap_demonstrated_on_tpu_target":
+            b["n_async_collective_fusions"] > 0 and
+            b["total_compute_ops_overlapped"] > 0 and
+            b["n_async_collective_fusions"] >
+            f["n_async_collective_fusions"],
+    }
+    return out
+
+
+# ------------------------------------------------------------------------- #
+# 3. LM parallelism modes: per-mode collective schedule on the TPU target
+# ------------------------------------------------------------------------- #
+
+def section_lm_modes(topo) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from analyze_schedule import analyze_module
+    from poseidon_tpu.runtime.hlo_comm import (measured_comm_summary,
+                                               parse_collectives)
+    from poseidon_tpu.models.transformer import (
+        TransformerConfig, build_dp_sp_train_step, build_dp_tp_train_step,
+        build_dp_pp_train_step, init_params, to_pp_layout, to_tp_layout)
+    from poseidon_tpu.models.moe import (MoEConfig, build_dp_ep_train_step,
+                                         init_moe_params)
+    from poseidon_tpu.proto.messages import SolverParameter
+    from poseidon_tpu.solvers.updates import init_state
+
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9)
+    out = {}
+
+    def record(name, step, lp, toks):
+        ls = init_state(lp)
+        t0 = time.time()
+        txt = step.lower(lp, ls, toks, toks,
+                         jax.random.PRNGKey(1)).compile().as_text()
+        r = analyze_module(txt)
+        comm = measured_comm_summary(parse_collectives(txt))
+        out[name] = {
+            "n_collectives": r["n_collectives"],
+            "collectives_by_kind": r["collectives_by_kind"],
+            "async_pairs": r["async_pairs"],
+            "mean_collective_pos": r["mean_collective_pos"],
+            "comm_bytes": comm,
+            "compile_seconds": round(time.time() - t0, 1),
+        }
+        print(f"[aot]   lm/{name}: {r['collectives_by_kind']}", flush=True)
+
+    rs = np.random.RandomState(0)
+
+    def tok(b, s):
+        return jnp.asarray(rs.randint(0, 256, size=(b, s), dtype=np.int32))
+
+    # dp x sp
+    mesh = _mesh(topo, ("data", "seq"), (2, 4))
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_seq=512, remat=True)
+    lp = init_params(cfg, jax.random.PRNGKey(0))
+    record("dp_sp", build_dp_sp_train_step(cfg, sp, mesh, donate=False),
+           lp, tok(4, 512))
+
+    # dp x tp
+    mesh = _mesh(topo, ("data", "model"), (2, 4))
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_seq=128)
+    lp = to_tp_layout(init_params(cfg, jax.random.PRNGKey(0)), cfg)
+    record("dp_tp",
+           build_dp_tp_train_step(cfg, sp, mesh, lp, donate=False),
+           lp, tok(4, 128))
+
+    # dp x pp
+    mesh = _mesh(topo, ("data", "stage"), (2, 4))
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                            n_layers=4, d_ff=256, max_seq=128)
+    lp = to_pp_layout(init_params(cfg, jax.random.PRNGKey(0)), cfg)
+    record("dp_pp",
+           build_dp_pp_train_step(cfg, sp, mesh, lp, microbatches=2,
+                                  donate=False),
+           lp, tok(8, 128))
+
+    # dp x ep
+    mesh = _mesh(topo, ("data", "expert"), (2, 4))
+    mcfg = MoEConfig(
+        base=TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                               n_layers=2, d_ff=256, max_seq=128),
+        n_experts=8, capacity=0, aux_weight=0.01)
+    lp = init_moe_params(mcfg, jax.random.PRNGKey(0))
+    record("dp_ep",
+           build_dp_ep_train_step(mcfg, sp, mesh, lp, donate=False),
+           lp, tok(16, 128))
+
+    # dp x pp x tp (3-D)
+    mesh = _mesh(topo, ("data", "stage", "model"), (2, 2, 2))
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=2,
+                            n_layers=4, d_ff=128, max_seq=128)
+    lp = to_pp_layout(to_tp_layout(init_params(cfg, jax.random.PRNGKey(0)),
+                                   cfg), cfg)
+    record("dp_pp_tp",
+           build_dp_pp_train_step(cfg, sp, mesh, lp, microbatches=2,
+                                  tp_axis="model", donate=False),
+           lp, tok(8, 128))
+
+    return out
+
+
+# ------------------------------------------------------------------------- #
+# 4. NHWC layout on the TPU compiler
+# ------------------------------------------------------------------------- #
+
+def section_nhwc(topo) -> dict:
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from poseidon_tpu import config
+    from poseidon_tpu.ops import nn
+
+    m1 = _mesh(topo, ("x",), (1,))
+    sh = NamedSharding(m1, P())
+    B, C, H, W, C1, C2 = 8, 3, 63, 63, 32, 64
+    avals = [jax.ShapeDtypeStruct(s, jnp.float32, sharding=sh)
+             for s in ((B, C, H, W), (C1, C, 3, 3), (C1,),
+                       (C2, C1, 3, 3), (C2,))]
+
+    def chain(x, w1, b1, w2, b2):
+        y = nn.conv2d(x, w1, b1, stride=(2, 2), pad=(1, 1))
+        y = jax.nn.relu(y)
+        y = nn.lrn_across_channels(y, 5, 1e-4, 0.75)
+        y = nn.max_pool(y, (3, 3), (2, 2), (0, 0))
+        return nn.conv2d(y, w2, b2, stride=(1, 1), pad=(1, 1))
+
+    out = {}
+    for layout in ("NCHW", "NHWC"):
+        with config.policy_scope(conv_layout=layout):
+            txt = _compile(chain, *avals)
+        out[f"{layout.lower()}_transposes"] = len(
+            re.findall(r"= [a-z0-9\[\]{},]+ transpose\(", txt))
+        out[f"{layout.lower()}_copies"] = txt.count(" copy(")
+    out["boundary_transposes_cancel"] = (
+        out["nhwc_transposes"] <= out["nchw_transposes"] + 2)
+    return out
+
+
+SECTIONS = {
+    "pallas_mosaic": section_pallas_mosaic,
+    "dwbp": section_dwbp,
+    "lm_modes": section_lm_modes,
+    "nhwc": section_nhwc,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sections", default="",
+                    help=f"subset of {','.join(SECTIONS)}")
+    args = ap.parse_args()
+    wanted = [s for s in args.sections.split(",") if s] or list(SECTIONS)
+
+    global STAMP
+    STAMP = _stamp()
+    print(f"[aot] stamp: {json.dumps(STAMP)}", flush=True)
+    topo = _topology()
+    summary = {"metric": "aot_tpu_check", "topology": TOPOLOGY}
+    rc = 0
+    for name in wanted:
+        t0 = time.time()
+        try:
+            doc = SECTIONS[name](topo)
+            doc["seconds"] = round(time.time() - t0, 1)
+            _write(name, doc)
+            if name == "pallas_mosaic":
+                summary["pallas_ok"] = doc["ok"]
+                rc |= 0 if doc["ok"] else 1
+            if name == "dwbp":
+                summary["dwbp_overlap_on_tpu_target"] = \
+                    doc["verdict"]["overlap_demonstrated_on_tpu_target"]
+            if name == "lm_modes":
+                summary["lm_modes"] = list(doc)
+            if name == "nhwc":
+                summary["nhwc_cancel"] = doc["boundary_transposes_cancel"]
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            _write(name, {"error": f"{type(e).__name__}: {e}",
+                          "trace": traceback.format_exc()
+                          .strip().splitlines()[-3:],
+                          "seconds": round(time.time() - t0, 1)})
+            summary.setdefault("failed_sections", []).append(name)
+            rc = 1
+    print(json.dumps(summary), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
