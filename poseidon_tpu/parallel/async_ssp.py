@@ -1,0 +1,434 @@
+"""Wait-free asynchronous SSP for the multi-process (DCN) tier.
+
+The one capability the compiled SSP step does not provide is the reference's
+actual Bösen execution model: workers that never barrier inside the staleness
+window. In the reference, a worker at clock c proceeds as long as its cached
+table rows reflect every worker's updates through clock c - s - 1; updates
+stream to the server asynchronously, and a too-fresh read BLOCKS just that
+worker until the server's clock catches up
+(ps/src/petuum_ps/consistency/ssp_consistency_controller.cpp:37-77; the
+server buffers early row requests until the required clock arrives,
+ps/src/petuum_ps/server/server.cpp:81-118).
+
+The compiled `build_ssp_train_step` is the right design *within* a
+synchronous pod (one SPMD program, deterministic reconcile cadence), but
+across preemptible processes the reconcile is a barrier the reference does
+not have: a fast process must wait for the slowest every (s+1) steps. This
+module restores the wait-free semantics where they matter — the host-driven
+process tier — while each process keeps its compiled SPMD step on its local
+mesh. TPU-native split: ICI tier = compiled collectives (sync), DCN tier =
+host-side asynchronous parameter service (this file).
+
+Design (the Bösen pieces, re-homed):
+
+- ``ParamService`` (rank 0, the name-node role): holds the anchor parameter
+  pytree and a per-worker vector clock. PUSH applies a worker's update
+  increment (additive, like the server's oplog apply) and bumps that
+  worker's clock; PULL returns the anchor snapshot + clock vector. No
+  global barrier exists anywhere in the service.
+- ``AsyncSSPClient`` (every worker): a background sender thread streams
+  PUSHes from a queue (non-blocking dispatch — the training thread never
+  waits on the socket), and ``gate(clock, staleness)`` blocks ONLY when the
+  pulled clock vector says some worker is more than ``staleness`` clocks
+  behind — the exact SSPConsistencyController read gate.
+- Read-my-writes: the client's cached params are
+  ``anchor + (own increments the anchor has not yet applied)``, the client
+  cache + oplog composition of the reference's process storage.
+
+A "clock" is one flush (``sync_every`` optimizer steps), matching the
+reference's per-iteration oplog flush granularity.
+
+Wire format: length-prefixed pickles of numpy pytrees over TCP on the
+launcher's control network (trusted, same trust domain as
+jax.distributed's own channel).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ParamService", "AsyncSSPClient", "run_async_ssp_worker"]
+
+
+# --------------------------------------------------------------------------- #
+# framing
+# --------------------------------------------------------------------------- #
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    data = buf.getvalue()
+    sock.sendall(struct.pack("!Q", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _tree_add(a: Dict, b: Dict) -> None:
+    """In-place a += b over a two-level {layer: {param: ndarray}} tree."""
+    for l, ps in b.items():
+        for p, v in ps.items():
+            a[l][p] += v
+
+
+def _tree_sub(a: Dict, b: Dict) -> Dict:
+    return {l: {p: a[l][p] - b[l][p] for p in ps} for l, ps in a.items()}
+
+
+def _tree_copy(a: Dict) -> Dict:
+    return {l: {p: np.array(v) for p, v in ps.items()} for l, ps in a.items()}
+
+
+# --------------------------------------------------------------------------- #
+# server
+# --------------------------------------------------------------------------- #
+
+class ParamService:
+    """Asynchronous parameter anchor for the process tier (rank-0 thread).
+
+    Applies PUSH increments the moment they arrive (no epoch, no barrier)
+    and serves PULL snapshots at whatever clock vector the moment holds —
+    the server side of Bösen's wait-free contract. ``server_logic="inc"``
+    is the reference's plain additive oplog apply."""
+
+    def __init__(self, params: Dict, n_workers: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.anchor = _tree_copy(params)
+        self.clocks = {w: -1 for w in range(n_workers)}  # applied clocks
+        self.n_workers = n_workers
+        self._lock = threading.Lock()
+        self._version = 0
+        # telemetry: the widest clock spread ever observed at an apply —
+        # the SSP bound holds iff this never exceeds staleness + 1
+        self.max_spread = 0
+        self.done_workers: set = set()
+        self._srv = socket.create_server((host, port))
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # ---- server loop ---------------------------------------------------- #
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.25)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = _recv_msg(conn)
+                kind = msg["kind"]
+                if kind == "push":
+                    with self._lock:
+                        _tree_add(self.anchor, msg["delta"])
+                        self.clocks[msg["worker"]] = msg["clock"]
+                        self._version += 1
+                        cs = list(self.clocks.values())
+                        if all(c >= 0 for c in cs):
+                            self.max_spread = max(self.max_spread,
+                                                  max(cs) - min(cs))
+                    _send_msg(conn, {"ok": True,
+                                     "clocks": dict(self.clocks)})
+                elif kind == "pull":
+                    # copy under the lock, serialize/send OUTSIDE it — a
+                    # slow client socket must not stall concurrent pushes
+                    # (that would be a barrier through the back door)
+                    with self._lock:
+                        snap = _tree_copy(self.anchor)
+                        clocks = dict(self.clocks)
+                        done = sorted(self.done_workers)
+                        version = self._version
+                    _send_msg(conn, {"anchor": snap, "clocks": clocks,
+                                     "done": done, "version": version})
+                elif kind == "clocks":
+                    with self._lock:
+                        _send_msg(conn, {"clocks": dict(self.clocks)})
+                elif kind == "done":
+                    # a worker finished its run (NOT a barrier: stragglers
+                    # keep training; the driver polls done_count to decide
+                    # when the anchor is final)
+                    with self._lock:
+                        self.done_workers.add(msg["worker"])
+                    _send_msg(conn, {"ok": True})
+                elif kind == "bye":
+                    _send_msg(conn, {"ok": True})
+                    return
+        except (ConnectionError, EOFError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------- #
+# client
+# --------------------------------------------------------------------------- #
+
+class AsyncSSPClient:
+    """Worker-side cache + oplog + non-blocking dispatch.
+
+    The training thread calls :meth:`push` (enqueue, returns immediately),
+    :meth:`gate` (blocks only on a staleness violation), and
+    :meth:`refresh` (pull + rebuild the read-my-writes cache)."""
+
+    def __init__(self, worker: int, addr: Tuple[str, int],
+                 staleness: int, n_workers: int = 0,
+                 retry_s: float = 10.0):
+        self.worker = worker
+        self.n_workers = n_workers if n_workers else worker + 1
+        self.staleness = staleness
+        deadline = time.time() + retry_s
+        while True:
+            try:
+                self._push_sock = socket.create_connection(addr)
+                self._pull_sock = socket.create_connection(addr)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+        self._push_lock = threading.Lock()
+        self._pull_lock = threading.Lock()
+        self._q: "queue.Queue" = queue.Queue()
+        self._pending: List[Tuple[int, Dict]] = []  # un-applied own updates
+        self._pending_lock = threading.Lock()
+        self.clocks: Dict[int, int] = {}
+        self.clock = -1          # last flushed clock
+        self._acked_clock = -1   # last clock the server acknowledged
+        self.blocked_s = 0.0     # cumulative gate wait (telemetry)
+        self.gate_blocks = 0
+        self.dead: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._sender = threading.Thread(target=self._send_loop, daemon=True)
+        self._sender.start()
+
+    # ---- non-blocking dispatch ------------------------------------------ #
+    def _send_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                clock, delta = self._q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            try:
+                with self._push_lock:
+                    _send_msg(self._push_sock,
+                              {"kind": "push", "worker": self.worker,
+                               "clock": clock, "delta": delta})
+                    ack = _recv_msg(self._push_sock)
+                self.clocks = ack["clocks"]
+                self._acked_clock = clock
+            except BaseException as e:  # noqa: BLE001 — surface, never lose
+                # a dead sender must FAIL the run, not silently drop oplogs:
+                # push()/gate()/drain all re-raise this
+                self.dead = e
+                return
+
+    def _check_alive(self) -> None:
+        if self.dead is not None:
+            raise RuntimeError(
+                f"worker {self.worker}: update dispatch died "
+                f"({type(self.dead).__name__}: {self.dead}); oplogs from "
+                f"clock {self._acked_clock + 1} on were never applied"
+            ) from self.dead
+
+    def push(self, delta: Dict) -> int:
+        """Flush one clock's accumulated update. Returns the new clock.
+        NEVER blocks on the network — the sender thread owns the socket."""
+        self._check_alive()
+        self.clock += 1
+        with self._pending_lock:
+            self._pending.append((self.clock, _tree_copy(delta)))
+        self._q.put((self.clock, delta))
+        return self.clock
+
+    def _drain(self, timeout_s: float = 10.0) -> None:
+        """Wait until the server ACKED every flushed clock (not merely
+        until the queue emptied — the sender may be mid-RPC on the last
+        delta, and 'done'/'bye' must not overtake it)."""
+        deadline = time.time() + timeout_s
+        while self._acked_clock < self.clock and time.time() < deadline:
+            self._check_alive()
+            time.sleep(0.005)
+
+    # ---- the SSP read gate ---------------------------------------------- #
+    def _min_other_clock(self) -> int:
+        """A peer we have not heard from yet counts as clock -1 (nothing
+        applied), NOT as caught up — otherwise the gate is unenforced
+        until the first ack/refresh arrives."""
+        others = [self.clocks.get(w, -1) for w in range(self.n_workers)
+                  if w != self.worker]
+        return min(others) if others else self.clock
+
+    def gate(self, clock: int, poll_s: float = 0.01,
+             timeout_s: float = 120.0) -> float:
+        """Block until every OTHER worker's applied clock is >= clock - s - 1
+        (ssp_consistency_controller.cpp:37-77: a read at clock c must see
+        all updates through c - s - 1). Within the window this returns
+        immediately — the wait-free property."""
+        self._check_alive()
+        need = clock - self.staleness - 1
+        if self._min_other_clock() >= need:
+            return 0.0
+        t0 = time.time()
+        self.gate_blocks += 1
+        while self._min_other_clock() < need:
+            if time.time() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"worker {self.worker} stuck at gate: need clock {need}, "
+                    f"have {self.clocks} (a peer died?)")
+            with self._pull_lock:
+                _send_msg(self._pull_sock, {"kind": "clocks"})
+                self.clocks = _recv_msg(self._pull_sock)["clocks"]
+            time.sleep(poll_s)
+        waited = time.time() - t0
+        self.blocked_s += waited
+        return waited
+
+    # ---- cache refresh (read-my-writes) --------------------------------- #
+    def refresh(self) -> Tuple[Dict, Dict[int, int]]:
+        """Pull the anchor and rebuild the local cache as
+        anchor + own-pending-updates-not-yet-applied-by-the-server."""
+        with self._pull_lock:
+            _send_msg(self._pull_sock, {"kind": "pull"})
+            snap = _recv_msg(self._pull_sock)
+        self.clocks = snap["clocks"]
+        applied = self.clocks.get(self.worker, -1)
+        cache = snap["anchor"]
+        with self._pending_lock:
+            self._pending = [(c, d) for c, d in self._pending if c > applied]
+            for _, d in self._pending:
+                _tree_add(cache, d)
+        return cache, dict(self.clocks)
+
+    def mark_done(self) -> None:
+        """Tell the service this worker's run is complete (not a barrier)."""
+        # every flushed clock must be ACKED first: 'done' must not overtake
+        # the final delta still in flight on the push socket
+        self._drain()
+        with self._pull_lock:
+            _send_msg(self._pull_sock, {"kind": "done",
+                                        "worker": self.worker})
+            _recv_msg(self._pull_sock)
+
+    def wait_all_done(self, n_workers: int, timeout_s: float = 300.0) -> None:
+        """Poll until every worker reported done (driver-side, rank 0)."""
+        t0 = time.time()
+        while True:
+            with self._pull_lock:
+                _send_msg(self._pull_sock, {"kind": "pull"})
+                snap = _recv_msg(self._pull_sock)
+            if len(snap.get("done", ())) >= n_workers:
+                return
+            if time.time() - t0 > timeout_s:
+                raise TimeoutError(f"only {snap.get('done')} finished")
+            time.sleep(0.05)
+
+    def close(self) -> None:
+        # drain so the last clock's update lands before bye (tolerate a
+        # dead sender here — close() runs on failure paths too)
+        try:
+            self._drain()
+        except RuntimeError:
+            pass
+        self._stop.set()
+        self._sender.join(timeout=5.0)
+        for s in (self._push_sock, self._pull_sock):
+            try:
+                _send_msg(s, {"kind": "bye"})
+                _recv_msg(s)
+            except (OSError, ConnectionError, EOFError):
+                pass
+            s.close()
+
+
+# --------------------------------------------------------------------------- #
+# worker driver
+# --------------------------------------------------------------------------- #
+
+def run_async_ssp_worker(
+    worker: int,
+    n_workers: int,
+    params: Dict,
+    local_step: Callable[[Dict, int], Tuple[Dict, float]],
+    n_clocks: int,
+    staleness: int,
+    service_addr: Optional[Tuple[str, int]] = None,
+    service: Optional[ParamService] = None,
+    sync_every: int = 1,
+    refresh_every: int = 1,
+    slow_s: float = 0.0,
+) -> Dict:
+    """Drive one worker through ``n_clocks`` flush clocks.
+
+    ``local_step(cache_params, step_index) -> (new_params, loss)`` is the
+    process-local compiled step (any intra-process parallelism stays inside
+    it); this driver owns only the DCN-tier exchange: gate -> step(s) ->
+    push increment -> refresh. ``slow_s`` injects per-clock straggler delay
+    (test harness). Returns the final cache + telemetry."""
+    if service is not None:
+        addr = ("127.0.0.1", service.port)
+    else:
+        addr = service_addr
+    cli = AsyncSSPClient(worker, addr, staleness, n_workers=n_workers)
+    cache = _tree_copy(params)
+    losses = []
+    t_start = time.time()
+    try:
+        for clock in range(n_clocks):
+            cli.gate(clock)
+            if slow_s:
+                time.sleep(slow_s)
+            before = _tree_copy(cache)
+            for k in range(sync_every):
+                cache, loss = local_step(cache, clock * sync_every + k)
+            losses.append(float(loss))
+            cli.push(_tree_sub(cache, before))
+            if (clock + 1) % refresh_every == 0:
+                cache, _ = cli.refresh()
+        wall = time.time() - t_start
+        cli.mark_done()
+        return {"params": cache, "losses": losses,
+                "blocked_s": cli.blocked_s, "gate_blocks": cli.gate_blocks,
+                "wall_s": wall, "final_clock": cli.clock}
+    finally:
+        cli.close()
