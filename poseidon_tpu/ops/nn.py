@@ -171,11 +171,18 @@ def _pool_dims(x, kernel, stride, pad):
 
 def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
                    layout: str = "NCHW"):
-    """Pool by combining k_h*k_w strided slices of the padded input.
+    """Pool via ``lax.reduce_window`` over a Caffe-padded input.
 
-    Equivalent to reduce_window but built from slice+elementwise ops, which
-    (unlike generic reduce_window in current JAX) differentiate cleanly inside
-    shard_map; XLA fuses the slice chain back into one windowed pass.
+    reduce_window is the TPU-native windowed reduction: XLA lowers its
+    max-backward to one select-and-scatter (first-max-wins on ties, which
+    is Caffe's `>`-update argmax rule, pooling_layer.cpp), where the
+    previous slice-chain formulation transposed into a pile of
+    pad-and-add ops — the round-5 cycle attribution put pooling BACKWARD
+    at 5x its forward and ~23% of the whole AlexNet step
+    (evidence/aot_tpu/layer_cycles.json). The historical reason for the
+    slice chain — reduce_window not differentiating inside shard_map — no
+    longer holds on current JAX.
+
     ``layout`` selects which axes are spatial: (2, 3) for NCHW, (1, 2) for
     NHWC (channels-last, the TPU-preferred layout the conv path uses under
     ``policy().conv_layout == "NHWC"``)."""
@@ -187,19 +194,28 @@ def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
     pads[ah] = (pad[0], hi_h)
     pads[aw] = (pad[1], hi_w)
     xp = jnp.pad(x, pads, constant_values=fill)
-    out = None
-    for dh in range(kernel[0]):
-        for dw in range(kernel[1]):
-            lo = [0, 0, 0, 0]
-            hi = list(xp.shape)
-            st = [1, 1, 1, 1]
-            lo[ah], lo[aw] = dh, dw
-            hi[ah] = dh + (oh - 1) * stride[0] + 1
-            hi[aw] = dw + (ow - 1) * stride[1] + 1
-            st[ah], st[aw] = stride
-            sl = lax.slice(xp, lo, hi, st)
-            out = sl if out is None else combine(out, sl)
-    return out
+    # crop to exactly the extent the oh x ow output grid consumes: Caffe's
+    # ceil-mode output clamp can leave the padded extent larger than
+    # (o-1)*s + k, and VALID reduce_window would emit extra rows there
+    lo = [0, 0, 0, 0]
+    hi = list(xp.shape)
+    hi[ah] = (oh - 1) * stride[0] + kernel[0]
+    hi[aw] = (ow - 1) * stride[1] + kernel[1]
+    xp = lax.slice(xp, lo, hi)
+    window = [1, 1, 1, 1]
+    window[ah], window[aw] = kernel
+    strides = [1, 1, 1, 1]
+    strides[ah], strides[aw] = stride
+    # literal scalar inits: jax only recognizes the differentiable
+    # reduce_window_{max,sum} monoids when init is a literal, not a traced
+    # array (a traced init falls back to generic reduce_window, which has
+    # no reverse-mode rule)
+    if fill == -jnp.inf:
+        red, init = lax.max, -float("inf")
+    else:
+        red, init = lax.add, 0.0
+    return lax.reduce_window(xp, init, red,
+                             tuple(window), tuple(strides), "VALID")
 
 
 def _pool_layout(x):

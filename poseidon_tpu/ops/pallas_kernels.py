@@ -32,6 +32,13 @@ from .attention import NEG_INF
 
 
 def _interpret_default() -> bool:
+    # POSEIDON_FORCE_PALLAS=1 compiles the real Mosaic kernels even when
+    # the RUNTIME backend is not TPU — the AOT-for-TPU-target path
+    # (scripts/aot_tpu_check.py), where default_backend() is cpu but the
+    # compile target is the chip
+    import os
+    if os.environ.get("POSEIDON_FORCE_PALLAS") == "1":
+        return False
     return jax.default_backend() != "tpu"
 
 
@@ -432,27 +439,54 @@ def _lrn_kernel(x_ref, o_ref, *, local_size: int, alpha: float, beta: float,
     o_ref[0] = (x * scale ** (-beta)).astype(o_ref.dtype)
 
 
+def _lrn_tile(hw: int, want: int, channels: int) -> tuple:
+    """(tile, padded_hw): a lane-legal spatial tiling. Mosaic requires the
+    block's minor dim to be a multiple of 128 OR the full array dim, and
+    one-tile-per-image VMEM-OOMs at GoogLeNet's norm2 scale (192 x 3136
+    bf16 + temps = 24.6 MB vs the 16 MB scoped limit — caught by the AOT
+    Mosaic gate, evidence/aot_tpu). Preference order, by the cost model:
+
+    1. the FULL spatial extent when its working set fits VMEM (always
+       layout-legal, zero pad/copy overhead — padding to lane multiples
+       measured +32% est. cycles on AlexNet's norms);
+    2. otherwise a 128-multiple tile with the extent padded up and the
+       pad sliced off after. LRN windows run over CHANNELS only, so zero
+       spatial padding is inert (scale = k > 0)."""
+    # ~8 f32 temps of (C, tile) live on the kernel stack (x, g, sq,
+    # padded, windowed, scale, r, out); stay under ~10 MB of the 16 MB
+    # scoped VMEM
+    budget = 10 * 2 ** 20
+    if channels * hw * 4 * 8 <= budget:
+        return hw, hw
+    cap = budget // (channels * 4 * 8)
+    want = max(128, (min(want, cap) // 128) * 128)
+    padded = -(-hw // want) * want
+    return want, padded
+
+
 def _lrn_fused_fwd_impl(x, local_size: int, alpha: float, beta: float,
                         k: float, tile: int, interpret: Optional[bool]):
     if interpret is None:
         interpret = _interpret_default()
     n, c, h, w = x.shape
     hw = h * w
-    tile = min(tile, hw)
-    if hw % tile:
-        tile = hw  # fall back to one tile per image
+    tile, hw_p = _lrn_tile(hw, tile, c)
     x2 = x.reshape(n, c, hw)
+    if hw_p != hw:
+        x2 = jnp.pad(x2, ((0, 0), (0, 0), (0, hw_p - hw)))
     out = pl.pallas_call(
         functools.partial(_lrn_kernel, local_size=local_size, alpha=alpha,
                           beta=beta, k=k, channels=c),
-        out_shape=jax.ShapeDtypeStruct((n, c, hw), x.dtype),
-        grid=(n, hw // tile),
+        out_shape=jax.ShapeDtypeStruct((n, c, hw_p), x.dtype),
+        grid=(n, hw_p // tile),
         in_specs=[pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(x2)
+    if hw_p != hw:
+        out = lax.slice(out, (0, 0, 0), (n, c, hw))
     return out.reshape(n, c, h, w)
 
 
@@ -467,16 +501,88 @@ def lrn_fused(x, local_size: int, alpha: float, beta: float, k: float = 1.0,
                                interpret)
 
 
+def _lrn_bwd_kernel(x_ref, g_ref, o_ref, *, local_size: int, alpha: float,
+                    beta: float, k: float, channels: int):
+    """One-pass LRN backward (the analytic Caffe gradient,
+    lrn_layer.cpp CrossChannelBackward):
+
+        dx_i = g_i * scale_i^-beta
+               - (2*alpha*beta/n) * x_i * sum_{j: i in win(j)} g_j*y_j/scale_j
+
+    where g_j*y_j/scale_j = g_j * x_j * scale_j^(-beta-1). The transpose
+    window is the forward window mirrored (pad (post, pre) instead of
+    (pre, post)). Everything stays in one VMEM tile — the round-5 cycle
+    attribution put the recompute-through-XLA backward at ~2/3 of the LRN
+    layers' 29%-of-step cost (evidence/aot_tpu/layer_cycles.json)."""
+    x = x_ref[0].astype(jnp.float32)  # (C, T)
+    g = g_ref[0].astype(jnp.float32)
+    pre = (local_size - 1) // 2
+    post = local_size - pre - 1
+    sq = x * x
+    padded = jnp.pad(sq, ((pre, post), (0, 0)))
+    windowed = jnp.zeros_like(sq)
+    for dc in range(local_size):
+        windowed = windowed + lax.slice_in_dim(padded, dc, dc + channels,
+                                               axis=0)
+    scale = k + (alpha / local_size) * windowed
+    r = g * x * scale ** (-beta - 1.0)
+    rp = jnp.pad(r, ((post, pre), (0, 0)))
+    rsum = jnp.zeros_like(r)
+    for dc in range(local_size):
+        rsum = rsum + lax.slice_in_dim(rp, dc, dc + channels, axis=0)
+    dx = g * scale ** (-beta) - (2.0 * alpha * beta / local_size) * x * rsum
+    o_ref[0] = dx.astype(o_ref.dtype)
+
+
+def lrn_fused_bwd(x, g, local_size: int, alpha: float, beta: float,
+                  k: float = 1.0, tile: int = 512,
+                  interpret: Optional[bool] = None):
+    """Fused LRN backward: dx from (x, g) in one VMEM pass per tile."""
+    if interpret is None:
+        interpret = _interpret_default()
+    n, c, h, w = x.shape
+    hw = h * w
+    tile, hw_p = _lrn_tile(hw, tile, c)
+    x2 = x.reshape(n, c, hw)
+    g2 = g.reshape(n, c, hw)
+    if hw_p != hw:
+        pad = ((0, 0), (0, 0), (0, hw_p - hw))
+        x2 = jnp.pad(x2, pad)
+        g2 = jnp.pad(g2, pad)
+    spec = pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
+                        memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_lrn_bwd_kernel, local_size=local_size,
+                          alpha=alpha, beta=beta, k=k, channels=c),
+        out_shape=jax.ShapeDtypeStruct((n, c, hw_p), x.dtype),
+        grid=(n, hw_p // tile),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(x2, g2)
+    if hw_p != hw:
+        out = lax.slice(out, (0, 0, 0), (n, c, hw))
+    return out.reshape(n, c, h, w)
+
+
 def _lrn_fused_vjp_fwd(x, local_size, alpha, beta, k, tile, interpret):
     return _lrn_fused_fwd_impl(x, local_size, alpha, beta, k, tile,
                                interpret), x
 
 
 def _lrn_fused_vjp_bwd(local_size, alpha, beta, k, tile, interpret, x, g):
-    from .nn import lrn_across_channels
-    _, vjp = jax.vjp(
-        lambda x_: lrn_across_channels(x_, local_size, alpha, beta, k), x)
-    return vjp(g)
+    if interpret is None:
+        interpret = _interpret_default()
+    if interpret:
+        # off-TPU: the differentiable XLA formulation (interpret-mode
+        # Pallas emulation would only slow the CPU mesh down)
+        from .nn import lrn_across_channels
+        _, vjp = jax.vjp(
+            lambda x_: lrn_across_channels(x_, local_size, alpha, beta, k),
+            x)
+        return vjp(g)
+    return (lrn_fused_bwd(x, g, local_size, alpha, beta, k, tile,
+                          interpret),)
 
 
 lrn_fused.defvjp(_lrn_fused_vjp_fwd, _lrn_fused_vjp_bwd)

@@ -543,6 +543,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    # async collective fusion must be staged into LIBTPU_INIT_ARGS before
+    # any command initializes the backend (it is the DWBP-overlap mechanism
+    # on TPU; a no-op on CPU runs — see config.enable_tpu_async_collectives)
+    from .. import config as _config
+    _config.enable_tpu_async_collectives()
     return args.fn(args)
 
 

@@ -98,6 +98,37 @@ def test_lrn_fused_gradient_matches_reference():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_lrn_fused_bwd_kernel_matches_analytic():
+    """The one-pass Pallas backward (interpret mode here, Mosaic on chip)
+    must reproduce the autodiff gradient of the XLA formulation — the
+    analytic Caffe gradient with the mirrored transpose window
+    (lrn_layer.cpp CrossChannelBackward)."""
+    from poseidon_tpu.ops.pallas_kernels import lrn_fused_bwd
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(2, 16, 6, 6).astype(np.float32))
+    g = jnp.asarray(rs.randn(2, 16, 6, 6).astype(np.float32))
+    _, vjp = jax.vjp(
+        lambda x_: lrn_across_channels(x_, 5, 1e-4, 0.75), x)
+    (want,) = vjp(g)
+    got = lrn_fused_bwd(x, g, 5, 1e-4, 0.75, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_fused_bwd_kernel_even_window():
+    """Asymmetric (even) local_size exercises the mirrored pre/post pads."""
+    from poseidon_tpu.ops.pallas_kernels import lrn_fused_bwd
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(1, 12, 4, 4).astype(np.float32))
+    g = jnp.asarray(rs.randn(1, 12, 4, 4).astype(np.float32))
+    _, vjp = jax.vjp(
+        lambda x_: lrn_across_channels(x_, 4, 2e-4, 0.9, 1.5), x)
+    (want,) = vjp(g)
+    got = lrn_fused_bwd(x, g, 4, 2e-4, 0.9, 1.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_maybe_lrn_fused_routing():
     """Off-TPU the router must take the XLA path bit-for-bit; on TPU it
     takes the Mosaic kernel (allclose)."""
