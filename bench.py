@@ -285,9 +285,13 @@ def main() -> None:
                  f"(set POSEIDON_BENCH_CPU=1 for an explicit CPU smoke run)",
                  probe)
 
+    from poseidon_tpu import config
+    # stage the async-collective flags before backend init (multi-chip
+    # gradient all-reduces fuse with backward compute; no-op on one chip)
+    config.enable_tpu_async_collectives()
+
     import jax
     import jax.numpy as jnp
-    from poseidon_tpu import config
 
     # POSEIDON_BENCH_PRNG=rbg swaps threefry for the TPU-cheap rbg
     # generator (dropout mask generation rides the step's critical path)
@@ -547,20 +551,30 @@ def main() -> None:
             checkpoint_partial(extras, "topk")
 
         # ---- Transformer LM (long-context flagship; beyond-reference) -----
+        # The LM performance identity: GPT-2-small shape (~136M params at
+        # vocab 32768, untied head) so tokens/s and MFU are anchored to a
+        # model worth measuring. MFU follows the 6*P*T convention; XLA's
+        # executed-flops count (includes remat recompute) is lm_hfu.
         if os.environ.get("POSEIDON_BENCH_LM",
                           "0" if cpu_ok else "1") == "1" and \
                 budget_left("lm"):
             from poseidon_tpu.models.transformer import (
-                TransformerConfig, build_dp_sp_train_step, init_params)
+                TransformerConfig, build_dp_sp_train_step, gpt_small_config,
+                init_params)
             from poseidon_tpu.parallel import make_mesh
             from poseidon_tpu.solvers.updates import init_state
             from poseidon_tpu.proto.messages import SolverParameter as SP
 
-            lm_seq = int(os.environ.get("POSEIDON_BENCH_LM_SEQ", "2048"))
+            lm_seq = int(os.environ.get("POSEIDON_BENCH_LM_SEQ", "1024"))
             lm_batch = int(os.environ.get("POSEIDON_BENCH_LM_BATCH", "8"))
-            lm_cfg = TransformerConfig(
-                vocab_size=32000, d_model=512, n_heads=8, n_layers=8,
-                d_ff=2048, max_seq=lm_seq, remat=True)
+            lm_preset = os.environ.get("POSEIDON_BENCH_LM_PRESET",
+                                       "gpt_small")
+            if lm_preset == "tiny":     # CPU smoke only — never a headline
+                lm_cfg = TransformerConfig(
+                    vocab_size=512, d_model=64, n_heads=2, n_layers=2,
+                    d_ff=128, max_seq=lm_seq, remat=True)
+            else:
+                lm_cfg = gpt_small_config(max_seq=lm_seq)
             lm_mesh = make_mesh(axes=("data", "seq"), shape=(n_dev, 1))
             lm_step = build_dp_sp_train_step(
                 lm_cfg, SP(base_lr=0.01, lr_policy="fixed", momentum=0.9),
@@ -569,26 +583,63 @@ def main() -> None:
             ls = init_state(lp)
             rs2 = np.random.RandomState(1)
             toks = jnp.asarray(rs2.randint(
-                0, 32000, size=(lm_batch * n_dev, lm_seq), dtype=np.int32))
+                0, lm_cfg.vocab_size, size=(lm_batch * n_dev, lm_seq),
+                dtype=np.int32))
             tgts = jnp.asarray(rs2.randint(
-                0, 32000, size=(lm_batch * n_dev, lm_seq), dtype=np.int32))
-            lp, ls, lm_m = lm_step(lp, ls, toks, tgts, jax.random.PRNGKey(1))
+                0, lm_cfg.vocab_size, size=(lm_batch * n_dev, lm_seq),
+                dtype=np.int32))
+            # ONE compile: the AOT executable supplies cost analysis AND
+            # runs the timing loop (calling lm_step would jit-compile the
+            # same 12-layer remat program a second time)
+            lm_exec = lm_step.lower(lp, ls, toks, tgts,
+                                    jax.random.PRNGKey(1)).compile()
+            lm_flops = 0.0
+            try:
+                lm_ca = lm_exec.cost_analysis()
+                if isinstance(lm_ca, (list, tuple)):
+                    lm_ca = lm_ca[0]
+                lm_flops = float(lm_ca.get("flops", 0.0))
+            except Exception:  # noqa: BLE001
+                pass
+            lp, ls, lm_m = lm_exec(lp, ls, toks, tgts, jax.random.PRNGKey(1))
             jax.block_until_ready(lm_m["loss"])
             t0 = time.perf_counter()
             lm_iters = max(3, iters // 4)
             for _ in range(lm_iters):
-                lp, ls, lm_m = lm_step(lp, ls, toks, tgts,
+                lp, ls, lm_m = lm_exec(lp, ls, toks, tgts,
                                        jax.random.PRNGKey(2))
             jax.block_until_ready(lm_m["loss"])
             lm_dt = (time.perf_counter() - t0) / lm_iters
             extras["lm_tokens_per_sec_per_chip"] = round(
                 lm_batch * lm_seq / lm_dt, 1)
+            n_par = lm_cfg.n_params()
+            model_flops = 6.0 * n_par * lm_batch * lm_seq  # the MFU convention
+
+            def _lm_rates(dt):
+                # MFU uses the 6*P*T convention; the executed-flops number
+                # (which under remat counts the backward's forward
+                # recompute, ~8*P*T) is reported separately as HFU
+                extras["lm_mfu"] = round(model_flops / dt / peak, 4)
+                if lm_flops:
+                    extras["lm_hfu"] = round(lm_flops / dt / peak, 4)
+
             # the LM step is one dispatch per step; correct for the measured
             # per-dispatch runtime round-trip to estimate the device rate
             lm_dev_dt = lm_dt - overhead_s
             if 0 < lm_dev_dt < lm_dt:
                 extras["lm_tokens_per_sec_per_chip_device"] = round(
                     lm_batch * lm_seq / lm_dev_dt, 1)
+                _lm_rates(lm_dev_dt)
+            else:
+                _lm_rates(lm_dt)
+            extras["lm_config"] = {
+                "preset": lm_preset, "params": n_par,
+                "d_model": lm_cfg.d_model, "n_layers": lm_cfg.n_layers,
+                "n_heads": lm_cfg.n_heads, "vocab": lm_cfg.vocab_size,
+                "batch_per_chip": lm_batch, "seq": lm_seq, "remat": True}
+            if lm_flops:
+                extras["lm_step_flops_per_device"] = lm_flops
+                extras["lm_flops_vs_6pt"] = round(lm_flops / model_flops, 3)
             extras["lm_seq"] = lm_seq
             extras["lm_loss"] = float(lm_m["loss"])
             del lp, ls

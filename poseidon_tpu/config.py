@@ -61,7 +61,9 @@ def enable_tpu_async_collectives() -> bool:
              " --xla_enable_async_all_reduce=true")
     cur = os.environ.get("LIBTPU_INIT_ARGS", "")
     if "async_collective_fusion_fuse_all_reduce" in cur:
-        return True
+        # the user set the flag explicitly — honor their value either way
+        # (an explicit =false is a deliberate baseline run, not "enabled")
+        return "async_collective_fusion_fuse_all_reduce=true" in cur
     import sys
     if "jax" in sys.modules:
         try:  # passive check only — never triggers (or hangs on) init

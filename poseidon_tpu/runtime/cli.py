@@ -67,10 +67,18 @@ def _engine_from_args(args, phase_nets=True):
                          shape=(dcn_slices, n // dcn_slices))
         comm.dcn_axis = "dcn"
     staleness = getattr(args, "staleness", 0)
+    async_cfg = None
+    if getattr(args, "async_ssp", False):
+        # the staleness bound belongs to the ASYNC tier; the local step
+        # stays plain sync SGD on this process's own mesh
+        async_cfg = {"staleness": staleness,
+                     "sync_every": getattr(args, "async_sync_every", 1)}
+        staleness = 0
     return Engine(sp, comm=comm, mesh=mesh, output_dir=args.output_dir,
                   staleness=staleness, sfb_auto=args.sfb_auto,
                   steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
-                  device_transform=getattr(args, "device_transform", False))
+                  device_transform=getattr(args, "device_transform", False),
+                  async_ssp=async_cfg)
 
 
 def cmd_train(args) -> int:
@@ -79,8 +87,23 @@ def cmd_train(args) -> int:
         import jax.numpy as jnp
         from .. import config
         config.set_policy(compute_dtype=jnp.bfloat16)
-    init_distributed(hostfile=args.hostfile or None,
-                     node_id=args.node_id if args.node_id >= 0 else None)
+    if getattr(args, "async_ssp", False):
+        # async-SSP: the processes stay INDEPENDENT jax runtimes — no
+        # jax.distributed world, no collective rendezvous; the only
+        # cross-process channel is the tier's parameter service. The tier
+        # reads the LOCAL launcher's env contract; a hostfile launch does
+        # not set it, and silently degrading to N isolated full-data runs
+        # would be worse than refusing.
+        import os as _os
+        if args.hostfile and "POSEIDON_PROC_ID" not in _os.environ:
+            raise SystemExit(
+                "--async_ssp currently rides the launch_local env contract "
+                "(POSEIDON_PROC_ID/NUM_PROCS/COORDINATOR); for a hostfile "
+                "cluster, start each node under that env (see "
+                "scripts/launch.py) instead of --hostfile/--node_id")
+    else:
+        init_distributed(hostfile=args.hostfile or None,
+                         node_id=args.node_id if args.node_id >= 0 else None)
     eng = _engine_from_args(args)
     eng.profile_steps = args.profile
     snapshot = args.snapshot
@@ -451,6 +474,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "applies every group's full update, the reference's "
                         "RowBatchInc semantics), so ~base_lr/n_groups is "
                         "the stable regime")
+    t.add_argument("--async_ssp", action="store_true",
+                   help="wait-free asynchronous SSP across launcher "
+                        "processes (the Bösen execution model, "
+                        "parallel/async_ssp.py): each process trains on "
+                        "its LOCAL mesh, parameter increments stream to a "
+                        "rank-0 service, reads gate on --staleness; no "
+                        "jax.distributed world, no cross-process barrier")
+    t.add_argument("--async_sync_every", type=int, default=1,
+                   help="optimizer iterations per async-SSP flush clock")
     t.add_argument("--hostfile", default="",
                    help="cluster hostfile ('<id> <ip> <port>' lines)")
     t.add_argument("--node_id", type=int, default=-1,
