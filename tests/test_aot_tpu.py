@@ -59,6 +59,13 @@ txt = jax.jit(lambda x: lrn_fused(x, 5, 1e-4, 0.75, 1.0,
     .compile().as_text()
 assert txt.count("tpu_custom_call") >= 1, "lrn"
 print("OK lrn")
+# grad routes through the one-pass Pallas BACKWARD kernel on TPU — it must
+# pass Mosaic too (fwd-only coverage shipped an unlowered bwd in round 5)
+txt = jax.jit(jax.grad(lambda x: lrn_fused(
+    x, 5, 1e-4, 0.75, 1.0, interpret=False).sum())).lower(x) \
+    .compile().as_text()
+assert txt.count("tpu_custom_call") >= 2, "lrn bwd"
+print("OK lrn_bwd")
 """
 
 
@@ -75,4 +82,4 @@ def test_flash_kernels_mosaic_compile_for_v5e():
                     f"{(r.stdout + r.stderr).strip()[-200:]}")
     assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
     assert "OK fwd" in r.stdout and "OK bwd" in r.stdout \
-        and "OK lrn" in r.stdout
+        and "OK lrn" in r.stdout and "OK lrn_bwd" in r.stdout
