@@ -1,0 +1,118 @@
+"""Engine hook for the wait-free async-SSP process tier.
+
+Turns `parallel/async_ssp.py` into a product feature:
+``train --async_ssp --staleness N`` under the multi-process launcher. Each
+process keeps its LOCAL compiled step (its own mesh, its own momentum
+history — the reference's client-side solver state) and this tier owns the
+only cross-process exchange: every ``sync_every`` optimizer iterations it
+flushes the parameter increment to the rank-0 ParamService (non-blocking),
+rebuilds the read-my-writes cache, and gates the NEXT clock on the SSP
+window — the Bösen execution model (SURVEY §2.2) riding under an unmodified
+Engine loop.
+
+No ``jax.distributed`` world exists in this mode: the processes are
+independent JAX runtimes (exactly the deployment the reference's PS serves,
+where workers share nothing but the server connection); the CLI skips
+``init_distributed`` and the Engine shards data by POSEIDON_PROC_ID.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..parallel.async_ssp import AsyncSSPClient, ParamService
+from .metrics import log
+
+
+def env_world() -> Tuple[int, int, Optional[str]]:
+    """(rank, n_procs, coordinator) from the launcher env contract."""
+    return (int(os.environ.get("POSEIDON_PROC_ID", "0")),
+            int(os.environ.get("POSEIDON_NUM_PROCS", "1")),
+            os.environ.get("POSEIDON_COORDINATOR"))
+
+
+def _to_host(tree: Dict) -> Dict:
+    return {l: {p: np.asarray(v, np.float32) for p, v in ps.items()}
+            for l, ps in tree.items()}
+
+
+class AsyncSSPTier:
+    """Owns the service (rank 0), the client, and the flush cadence."""
+
+    def __init__(self, params: Dict, staleness: int, sync_every: int = 1,
+                 service_port: Optional[int] = None):
+        self.rank, self.n_procs, coord = env_world()
+        self.staleness = staleness
+        self.sync_every = max(1, sync_every)
+        host = "127.0.0.1"
+        port = service_port
+        if coord:
+            chost, cport = coord.rsplit(":", 1)
+            host = chost
+            if port is None:
+                port = int(cport) + 1
+        if port is None:
+            port = 12356
+        self.service = None
+        host0 = _to_host(params)
+        if self.rank == 0:
+            self.service = ParamService(host0, n_workers=self.n_procs,
+                                        host=host, port=port)
+        self.client = AsyncSSPClient(self.rank, (host, port), staleness,
+                                     n_workers=self.n_procs)
+        self._prev = host0
+        self._iters_since = 0
+        self._t0 = time.time()
+        log(f"async-SSP tier: {self.n_procs} workers, staleness "
+            f"{staleness}, flush every {self.sync_every} iter(s), service "
+            f"{host}:{port}", rank=self.rank)
+
+    # ------------------------------------------------------------------ #
+    def after_iters(self, engine, n_iters: int) -> None:
+        """Called by Engine.train after every completed dispatch (n_iters
+        optimizer steps). Flush + refresh + gate at the clock cadence."""
+        self._iters_since += n_iters
+        if self._iters_since < self.sync_every:
+            return
+        self._iters_since = 0
+        cur = _to_host(engine.params)
+        delta = {l: {p: cur[l][p] - self._prev[l][p] for p in ps}
+                 for l, ps in cur.items()}
+        clock = self.client.push(delta)
+        cache, _ = self.client.refresh()
+        self._prev = cache
+        engine.params = jax.device_put(
+            {l: {p: v for p, v in ps.items()} for l, ps in cache.items()},
+            engine.train_step.replicated)
+        self.client.gate(clock + 1)
+
+    def finish(self, engine) -> Dict[str, float]:
+        # flush the residual delta of any iterations past the last
+        # sync_every boundary — trailing updates must reach the anchor
+        if self._iters_since:
+            self._iters_since = self.sync_every  # force the flush
+            self.after_iters(engine, 0)
+        self.client.mark_done()
+        out = {"async_blocked_s": round(self.client.blocked_s, 3),
+               "async_gate_blocks": float(self.client.gate_blocks),
+               "async_final_clock": float(self.client.clock)}
+        if self.service is not None:
+            # poll (not barrier) until the stragglers flush their last clock
+            self.client.wait_all_done(self.n_procs)
+            out["async_max_spread"] = float(self.service.max_spread)
+            # the final anchor is the job's result: fold it into rank 0's
+            # params so snapshots/eval see every worker's updates
+            engine.params = jax.device_put(
+                self.service.anchor, engine.train_step.replicated)
+            time.sleep(0.2)
+            self.service.close()
+        self.client.close()
+        log("async-SSP tier: " + ", ".join(f"{k}={v}"
+                                           for k, v in out.items()),
+            rank=self.rank)
+        return out

@@ -78,6 +78,7 @@ class Engine:
         sfb_auto: bool = False,
         steps_per_dispatch: int = 1,
         device_transform: bool = False,
+        async_ssp: Optional[Dict] = None,
     ):
         self.sp = sp
         self.mesh = mesh or make_mesh()
@@ -87,6 +88,17 @@ class Engine:
         self.output_dir = output_dir
         self.stats = StatsRegistry()
         self.rank = jax.process_index()
+        self.world = jax.process_count()
+        # wait-free async-SSP process tier (runtime/async_tier.py): the
+        # processes are INDEPENDENT jax runtimes (no jax.distributed world),
+        # so rank/world come from the launcher env, the local mesh is this
+        # process's own devices, and the only cross-process exchange is the
+        # tier's parameter service
+        self._async_cfg = async_ssp
+        self._async_tier = None
+        if async_ssp is not None:
+            from .async_tier import env_world
+            self.rank, self.world, _ = env_world()
         self.memory_data = memory_data
         # uint8 ingest + on-device (x - mean) * scale (the TPU-native split
         # of DataTransformer): train pipelines ship quarter-width bytes and
@@ -290,7 +302,7 @@ class Engine:
         # shards the record space across hosts (shared_file_system-style).
         return build_phase_pipelines(
             net_param, phase, batch_multiplier=jax.local_device_count(),
-            shard=Shard(self.rank, jax.process_count()),
+            shard=Shard(self.rank, self.world),
             memory_data=self.memory_data,
             device_transform=(self._device_transform and phase == "TRAIN"))
 
@@ -453,6 +465,9 @@ class Engine:
         t_start = time.time()
         last: Dict[str, float] = {}
         pending: List[Dict] = []  # un-materialized device metrics
+        if self._async_cfg is not None and self._async_tier is None:
+            from .async_tier import AsyncSSPTier
+            self._async_tier = AsyncSSPTier(self.params, **self._async_cfg)
         # profiler window: skip a couple of warmup/compile steps
         profile_start = it + 2
         profiling = False
@@ -552,6 +567,8 @@ class Engine:
             pending.append(m)
             self.stats.add("train_iters", chunk)
             self.stats.add_time("train_step", time.time() - t0)
+            if self._async_tier is not None:
+                self._async_tier.after_iters(self, chunk)
 
             if not sp.display and len(pending) >= 64:
                 # no display cadence configured: flush periodically so the
@@ -579,6 +596,14 @@ class Engine:
             jax.profiler.stop_trace()
             log(f"Wrote profiler trace to "
                 f"{os.path.join(self.output_dir, 'profile')}", rank=self.rank)
+        if self._async_tier is not None:
+            # flush the last clock + fold the final anchor into rank 0's
+            # params BEFORE the after-train snapshot, so the snapshot holds
+            # every worker's updates
+            tier_stats = self._async_tier.finish(self)
+            for k, v in tier_stats.items():
+                self.stats.add(k, v)
+            self._async_tier = None
         if sp.snapshot_after_train:
             self.snapshot_now()
         self.stats.add_time("train_total", time.time() - t_start)

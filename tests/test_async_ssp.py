@@ -219,6 +219,59 @@ def test_digits_convergence_matches_sync():
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(not os.path.isdir(
+    os.path.join(REPO, "examples/mnist/mnist_train_lmdb")),
+    reason="synthetic MNIST LMDB not generated")
+def test_cli_train_async_ssp_two_process(tmp_path):
+    """The product surface: `train --async_ssp --staleness 2` across 2 REAL
+    launcher processes training LeNet from the LMDB — independent jax
+    runtimes, disjoint data shards, rank-0 parameter service, wait-free
+    gates. Both ranks must exit clean, training must progress, and the
+    tier telemetry (final clock + spread) must land in the rank-0 log."""
+    scripts = os.path.join(REPO, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import launch
+
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f"""
+net: "{REPO}/examples/mnist/lenet_train_test.prototxt"
+base_lr: 0.01
+lr_policy: "fixed"
+momentum: 0.9
+display: 5
+max_iter: 12
+test_interval: 0
+snapshot_after_train: true
+snapshot_prefix: "lenet_async"
+random_seed: 7
+""")
+    (tmp_path / "p0").mkdir()
+    (tmp_path / "p1").mkdir()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rc, raw_logs = launch.launch_local(
+        2, 4, port,
+        ["train", "--solver", str(solver), "--async_ssp",
+         "--staleness", "2",
+         "--output_dir", str(tmp_path / "p{proc_id}")],
+        capture=True)
+    logs = [b.decode() for b in raw_logs]
+    assert rc == 0, logs[0][-2000:] + logs[1][-2000:]
+    assert "async-SSP tier: 2 workers" in logs[0]
+    assert "Iteration 10" in logs[0]
+    assert "async_final_clock=11.0" in logs[0], logs[0][-800:]
+    # rank 0's post-train snapshot holds the final ANCHOR (all workers'
+    # updates folded in), written through the standard snapshot path
+    import numpy as np_
+    snap = np_.load(str(tmp_path / "p0" / "lenet_async_iter_12.solverstate"
+                                          ".npz"))
+    assert any(k.startswith("params/") for k in snap.files)
+
+
+@pytest.mark.slow
 def test_two_process_wait_free():
     """The deployment shape: 2 REAL processes through scripts/launch.py
     --local, rank 0 hosting the ParamService, rank 1 an artificial
