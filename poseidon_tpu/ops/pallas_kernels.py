@@ -593,8 +593,14 @@ def maybe_lrn_fused(x, local_size: int, alpha: float, beta: float,
     """Route ACROSS_CHANNELS LRN through the fused Pallas kernel on real
     TPU hardware (one HBM round-trip instead of the unfused chain); fall
     back to the XLA formulation everywhere else (interpret-mode emulation
-    would only slow things down)."""
+    would only slow things down). POSEIDON_DISABLE_PALLAS_LRN=1 forces the
+    XLA path on TPU too — the A/B knob for the open question from the
+    round-5 cost attribution (the custom call's operand-layout copies are
+    ~24% of AlexNet's estimated cycles; whether the fused kernel still
+    wins on the wall clock is a live-chip measurement)."""
+    import os
     from .nn import lrn_across_channels
-    if not _interpret_default():
+    if not _interpret_default() and \
+            os.environ.get("POSEIDON_DISABLE_PALLAS_LRN") != "1":
         return lrn_fused(x, local_size, alpha, beta, k)
     return lrn_across_channels(x, local_size, alpha, beta, k)

@@ -60,11 +60,13 @@ txt = jax.jit(lambda x: lrn_fused(x, 5, 1e-4, 0.75, 1.0,
 assert txt.count("tpu_custom_call") >= 1, "lrn"
 print("OK lrn")
 # grad routes through the one-pass Pallas BACKWARD kernel on TPU — it must
-# pass Mosaic too (fwd-only coverage shipped an unlowered bwd in round 5)
+# pass Mosaic too (fwd-only coverage shipped an unlowered bwd in round 5).
+# jax.grad discards the primal output, so XLA DCEs the FORWARD custom call
+# (its residual is just x): the one surviving call IS the backward kernel.
 txt = jax.jit(jax.grad(lambda x: lrn_fused(
     x, 5, 1e-4, 0.75, 1.0, interpret=False).sum())).lower(x) \
     .compile().as_text()
-assert txt.count("tpu_custom_call") >= 2, "lrn bwd"
+assert txt.count("tpu_custom_call") >= 1, "lrn bwd"
 print("OK lrn_bwd")
 """
 

@@ -20,7 +20,6 @@ import re
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from poseidon_tpu import config
 from poseidon_tpu.ops import nn

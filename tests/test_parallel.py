@@ -73,18 +73,32 @@ def test_dense_dp_matches_single_device(mesh, lenet_net, rng_np):
     # Use a fixed rng fed identically; dropout-free net so rng is inert.
     ts = build_train_step(lenet_net, sp, mesh, CommConfig(reduce="sum"),
                           donate=False)
+    # ONE step: the re-layout contract at its strongest — sharded and
+    # single-device params agree to f32 epsilon (measured 3e-8).
+    p1, s1, _ = ts.step(params, init_train_state(params), batch,
+                        jax.random.PRNGKey(99))
+    want1 = _single_device_reference(lenet_net, sp, params, batch, 1, rng_np)
+    for l in want1:
+        for k in want1[l]:
+            np.testing.assert_allclose(
+                np.asarray(p1[l][k]), np.asarray(want1[l][k]),
+                rtol=1e-5, atol=1e-6, err_msg=f"step1 {l}/{k}")
+
     p, s = params, init_train_state(params)
     for _ in range(3):
         p, s, metrics = ts.step(p, s, batch, jax.random.PRNGKey(99))
-
     want = _single_device_reference(lenet_net, sp, params, batch, 3, rng_np)
     for l in want:
         for k in want[l]:
-            # psum tree-reduction order differs from the sequential host sum;
-            # float32 noise compounds over the 3 momentum steps.
+            # Over multiple steps exactness is unattainable for ANY two
+            # valid schedules: psum tree-reduction order differs from the
+            # sequential host sum by ~1 ulp, and max-pool's argmax can flip
+            # on a near-tie once params differ by epsilon, re-routing one
+            # window's gradient entirely (observed: 1/500 conv1 weights at
+            # 8e-4 after 3 momentum steps; step 1 is at 3e-8).
             np.testing.assert_allclose(
                 np.asarray(p[l][k]), np.asarray(want[l][k]),
-                rtol=1e-2, atol=2e-4, err_msg=f"{l}/{k}")
+                rtol=2e-2, atol=1.5e-3, err_msg=f"{l}/{k}")
 
 
 def test_sfb_matches_dense(mesh, lenet_net, rng_np):
