@@ -590,17 +590,19 @@ lrn_fused.defvjp(_lrn_fused_vjp_fwd, _lrn_fused_vjp_bwd)
 
 def maybe_lrn_fused(x, local_size: int, alpha: float, beta: float,
                     k: float = 1.0):
-    """Route ACROSS_CHANNELS LRN through the fused Pallas kernel on real
-    TPU hardware (one HBM round-trip instead of the unfused chain); fall
-    back to the XLA formulation everywhere else (interpret-mode emulation
-    would only slow things down). POSEIDON_DISABLE_PALLAS_LRN=1 forces the
-    XLA path on TPU too — the A/B knob for the open question from the
-    round-5 cost attribution (the custom call's operand-layout copies are
-    ~24% of AlexNet's estimated cycles; whether the fused kernel still
-    wins on the wall clock is a live-chip measurement)."""
+    """ACROSS_CHANNELS LRN routing. Default: the XLA formulation
+    everywhere — the round-5 TPU cost-model A/B
+    (evidence/aot_tpu/layer_cycles.json) showed the Pallas kernel's
+    operand-layout boundary copies alone cost more than the whole fused
+    XLA chain once pooling moved to reduce_window (GoogLeNet 67.1M est
+    cycles XLA vs 78.3M Pallas-with-unmodeled-kernel; AlexNet's norm1
+    attribution under Pallas was ~25% of the step, nearly all copies).
+    ``POSEIDON_PALLAS_LRN=1`` opts back into the Pallas fwd+bwd kernels —
+    kept for the live-chip wall-clock A/B that can overrule a cost
+    model."""
     import os
     from .nn import lrn_across_channels
     if not _interpret_default() and \
-            os.environ.get("POSEIDON_DISABLE_PALLAS_LRN") != "1":
+            os.environ.get("POSEIDON_PALLAS_LRN") == "1":
         return lrn_fused(x, local_size, alpha, beta, k)
     return lrn_across_channels(x, local_size, alpha, beta, k)

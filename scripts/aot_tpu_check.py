@@ -496,10 +496,11 @@ def section_layer_cycles(topo) -> dict:
                                        init_train_state)
     from poseidon_tpu.proto.messages import SolverParameter
 
-    # route LRN through the real Mosaic kernels (fwd + one-pass bwd), as
-    # on the chip — default dispatch keys off the RUNTIME backend (cpu).
-    # Restored in the finally below: leaking this would silently change
-    # LATER sections' cost-model evidence with execution order.
+    # FORCE_PALLAS makes kernel dispatch behave as on-chip (flash etc.);
+    # LRN stays on its product default (XLA — the Pallas LRN lost the
+    # round-5 cost A/B; opt back with POSEIDON_PALLAS_LRN=1 to re-measure).
+    # Restored via main()'s env snapshot: leaking this would silently
+    # change LATER sections' cost-model evidence with execution order.
     saved_fp = os.environ.get("POSEIDON_FORCE_PALLAS")
     os.environ["POSEIDON_FORCE_PALLAS"] = "1"
     mesh = _mesh(topo, ("data",), (1,))

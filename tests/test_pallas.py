@@ -130,15 +130,15 @@ def test_lrn_fused_bwd_kernel_even_window():
 
 
 def test_maybe_lrn_fused_routing():
-    """Off-TPU the router must take the XLA path bit-for-bit; on TPU it
-    takes the Mosaic kernel (allclose)."""
+    """Default routing is the XLA formulation EVERYWHERE — the round-5
+    cost-model A/B retired the Pallas default (its boundary copies cost
+    more than the fused XLA chain; evidence/aot_tpu/layer_cycles.json).
+    POSEIDON_PALLAS_LRN=1 opts back in on TPU; the kernel itself is
+    covered by the interpret-mode tests above and the Mosaic AOT gate
+    (tests/test_aot_tpu.py) — it cannot EXECUTE on the CPU runtime."""
     from poseidon_tpu.ops.pallas_kernels import maybe_lrn_fused
     rs = np.random.RandomState(3)
     x = jnp.asarray(rs.randn(1, 8, 5, 5).astype(np.float32))
-    got = maybe_lrn_fused(x, 5, 1e-4, 0.75)
     want = lrn_across_channels(x, 5, 1e-4, 0.75)
-    if jax.default_backend() == "tpu":
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=1e-5, atol=1e-6)
-    else:
-        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    got = maybe_lrn_fused(x, 5, 1e-4, 0.75)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
