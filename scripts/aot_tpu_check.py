@@ -570,6 +570,66 @@ def section_layer_cycles(topo) -> dict:
     return out
 
 
+# ------------------------------------------------------------------------- #
+# 6. Headline-config search: layout x stem rewrite, ranked by the cost model
+# ------------------------------------------------------------------------- #
+
+def section_cnn_configs(topo) -> dict:
+    """Compile the headline AlexNet step (batch 256 @ 227, bf16) under the
+    four {conv_layout} x {conv_s2d} configs and rank them by total
+    estimated cycles — picking the bench's starting configuration from the
+    TPU compiler's own model instead of burning tunnel minutes on losing
+    A/Bs (the live A/Bs in bench.py remain the decider)."""
+    import re as _re
+
+    import jax
+    import jax.numpy as jnp
+
+    from poseidon_tpu import config as pconfig
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import (CommConfig, build_train_step,
+                                       init_train_state)
+    from poseidon_tpu.proto.messages import SolverParameter
+
+    mesh = _mesh(topo, ("data",), (1,))
+    out = {}
+    for layout in ("NCHW", "NHWC"):
+        for s2d in (False, True):
+            name = f"{layout.lower()}{'_s2d' if s2d else ''}"
+            t0 = time.time()
+            with pconfig.policy_scope(compute_dtype=jnp.bfloat16,
+                                      conv_layout=layout, conv_s2d=s2d):
+                net = Net(zoo.alexnet(num_classes=1000,
+                                      with_accuracy=False),
+                          phase="TRAIN",
+                          source_shapes={"data": (256, 3, 227, 227),
+                                         "label": (256,)})
+                sp = SolverParameter(base_lr=0.01, lr_policy="fixed",
+                                     momentum=0.9)
+                comm = CommConfig()
+                ts = build_train_step(net, sp, mesh, comm, donate=False)
+                params = net.init(jax.random.PRNGKey(0))
+                state = init_train_state(params, comm, 1)
+                feed = {"data": jnp.zeros((256, 3, 227, 227), jnp.float32),
+                        "label": jnp.zeros((256,), jnp.int32)}
+                txt = (ts.lowerable or ts.step).lower(
+                    params, state, feed,
+                    jax.random.PRNGKey(1)).compile().as_text()
+            cycles = sum(int(m) for m in
+                         _re.findall(r'"estimated_cycles":"(\d+)"', txt))
+            out[name] = {"est_cycles": cycles,
+                         "compile_seconds": round(time.time() - t0, 1)}
+            print(f"[aot]   cnn_configs/{name}: {cycles} est cycles",
+                  flush=True)
+    best = min(out, key=lambda k: out[k]["est_cycles"])
+    base = out["nchw"]["est_cycles"]
+    for k in out:
+        out[k]["vs_nchw"] = round(base / max(out[k]["est_cycles"], 1), 3)
+    out["best"] = best
+    return out
+
+
 SECTIONS = {
     "pallas_mosaic": section_pallas_mosaic,
     "dwbp": section_dwbp,
@@ -577,6 +637,7 @@ SECTIONS = {
     "nhwc": section_nhwc,
     "layer_cycles": section_layer_cycles,
     "lm_gpt_small": section_lm_gpt_small,
+    "cnn_configs": section_cnn_configs,
 }
 
 
