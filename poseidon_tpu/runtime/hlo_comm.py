@@ -40,6 +40,12 @@ _OP_RE = re.compile(
 _SHAPE_RE = re.compile(r"\b(\w+)\[([\d,]*)\](?:\{[\d,]*\})?")
 _GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
 _IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+# collective-permute carries source_target_pairs instead of replica_groups
+# (e.g. source_target_pairs={{0,1},{1,2},...}); without parsing it the op
+# fell to group_size=1 and the summary FILTERED the whole ring out —
+# caught by the round-5 long-context capture reporting 0 collectives for
+# a program with 48 ring permutes.
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}")
 
 
 @dataclass
@@ -124,12 +130,17 @@ def parse_collectives(hlo_text: str) -> List[Collective]:
             continue
         g = _GROUPS_RE.search(line)
         gi = _IOTA_GROUPS_RE.search(line)
+        gp = _PAIRS_RE.search(line)
         if g:
             groups = [grp for grp in g.group(1).split("},{")]
             group_size = len(groups[0].strip("{}").split(","))
             n_groups = len(groups)
         elif gi:  # iota form: replica_groups=[n_groups,group_size]<=[N]
             n_groups, group_size = int(gi.group(1)), int(gi.group(2))
+        elif gp:  # permute ring: participants = distinct devices in pairs
+            devs = {d for pair in gp.group(1).split("},{")
+                    for d in pair.strip("{}").split(",")}
+            group_size, n_groups = max(len(devs), 2), 1
         else:
             group_size, n_groups = 1, 1
         out.append(Collective(kind=kind, dtype=first[0], shape=first[1],
