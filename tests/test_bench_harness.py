@@ -33,7 +33,9 @@ def _run_bench(env_extra, timeout=420):
 
 def test_refuses_silent_cpu_fallback():
     """Default mode on a CPU-only machine must FAIL with the structured
-    line (never report CPU throughput as the TPU headline)."""
+    line (never report CPU throughput as the TPU headline), and any
+    carried-forward last_good must be LOUDLY labeled stale (round-4
+    verdict: a last_good passing silently as fresh)."""
     rc, payload = _run_bench({"POSEIDON_BENCH_PROBE_TIMEOUT": "60",
                               "POSEIDON_BENCH_PROBE_ATTEMPTS": "1"})
     assert rc != 0
@@ -41,6 +43,9 @@ def test_refuses_silent_cpu_fallback():
     assert "refusing" in payload["error"] or "unavailable" in payload["error"]
     assert payload["metric"] == \
         "alexnet_ilsvrc12_train_images_per_sec_per_chip"
+    if os.path.exists(os.path.join(REPO, "BENCH_last_good.json")):
+        assert payload["last_good"]["stale_carryover"] is True
+        assert "age_hours" in payload["last_good"]
 
 
 def test_probe_backend_reports_platform():
@@ -65,3 +70,10 @@ def test_cpu_smoke_emits_full_line():
     assert payload["backend"] == "cpu"
     assert payload["value"] > 0
     assert payload["alexnet_step_flops_per_device"] > 0
+    # per-section checkpointing: the completed headline section must have
+    # landed on disk even before the final line (a mid-run SIGKILL loses
+    # nothing — round-3's 1200 s rc -9 whole-window loss, made impossible)
+    with open(os.path.join(REPO, "evidence", "bench_partial.json")) as f:
+        partial = json.load(f)
+    assert "alexnet" in partial["sections_done"]
+    assert partial["alexnet_step_ms"] > 0

@@ -139,3 +139,32 @@ def test_async_start_tuple_payload_normalization():
         pytest.approx(600.0)
     assert colls["reduce-scatter_sync"].wire_bytes_per_device() == \
         pytest.approx(300.0)
+
+
+def test_collective_permute_ring_counted():
+    """collective-permute carries source_target_pairs, NOT replica_groups;
+    before round 5 it fell to group_size=1 and the summary filtered the
+    whole ring out — a 16k-token ring-attention capture reported ZERO
+    collectives. The ring's bytes must survive into the summary."""
+    from poseidon_tpu.runtime.hlo_comm import (measured_comm_summary,
+                                               parse_collectives)
+    hlo = "\n".join([
+        # async permute: (operand, result, u32 contexts) -> payload = one
+        "%cp = (bf16[4,256]{1,0}, bf16[4,256]{1,0}, u32[], u32[]) "
+        "collective-permute-start(%x), channel_id=1, "
+        "source_target_pairs={{0,1},{1,2},{2,3},{3,4},{4,5},{5,6},{6,7},"
+        "{7,0}}",
+        # sync permute
+        "%cp2 = f32[100]{0} collective-permute(%y), "
+        "source_target_pairs={{0,1},{1,0}}",
+    ])
+    colls = parse_collectives(hlo)
+    assert len(colls) == 2
+    ring, pair = colls
+    assert ring.kind == "collective-permute"
+    assert ring.group_size == 8          # 8 distinct ring participants
+    assert ring.payload_bytes == 4 * 256 * 2 + 4  # one bf16 copy + u32s/2
+    assert pair.group_size == 2
+    s = measured_comm_summary(colls)
+    assert s["n_collectives"] == 2
+    assert s["by_kind"]["collective-permute"] > 0
