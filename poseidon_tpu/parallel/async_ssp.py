@@ -124,6 +124,12 @@ class ParamService:
         # the SSP bound holds iff this never exceeds staleness + 1
         self.max_spread = 0
         self.done_workers: set = set()
+        # elasticity (beyond the reference's fail-fast, comm_bus.hpp:22-24):
+        # a worker whose connection dies WITHOUT a clean bye/done is marked
+        # failed; surviving workers' gates then exclude it instead of
+        # timing out, and its already-applied clocks stay in the anchor
+        # (bounded update loss = its un-flushed oplog, the PS failure model)
+        self.failed_workers: set = set()
         self._srv = socket.create_server((host, port))
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
@@ -148,21 +154,30 @@ class ParamService:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
+        worker: Optional[int] = None
+        abnormal = False
         try:
             while not self._stop.is_set():
                 msg = _recv_msg(conn)
                 kind = msg["kind"]
-                if kind == "push":
+                if "worker" in msg:
+                    worker = msg["worker"]
+                if kind == "hello":
+                    _send_msg(conn, {"ok": True})
+                elif kind == "push":
                     with self._lock:
                         _tree_add(self.anchor, msg["delta"])
                         self.clocks[msg["worker"]] = msg["clock"]
                         self._version += 1
-                        cs = list(self.clocks.values())
-                        if all(c >= 0 for c in cs):
+                        cs = [c for w, c in self.clocks.items()
+                              if w not in self.failed_workers]
+                        if cs and all(c >= 0 for c in cs):
                             self.max_spread = max(self.max_spread,
                                                   max(cs) - min(cs))
                     _send_msg(conn, {"ok": True,
-                                     "clocks": dict(self.clocks)})
+                                     "clocks": dict(self.clocks),
+                                     "failed":
+                                         sorted(self.failed_workers)})
                 elif kind == "pull":
                     # copy under the lock, serialize/send OUTSIDE it — a
                     # slow client socket must not stall concurrent pushes
@@ -171,12 +186,16 @@ class ParamService:
                         snap = _tree_copy(self.anchor)
                         clocks = dict(self.clocks)
                         done = sorted(self.done_workers)
+                        failed = sorted(self.failed_workers)
                         version = self._version
                     _send_msg(conn, {"anchor": snap, "clocks": clocks,
-                                     "done": done, "version": version})
+                                     "done": done, "failed": failed,
+                                     "version": version})
                 elif kind == "clocks":
                     with self._lock:
-                        _send_msg(conn, {"clocks": dict(self.clocks)})
+                        _send_msg(conn, {"clocks": dict(self.clocks),
+                                         "failed":
+                                             sorted(self.failed_workers)})
                 elif kind == "done":
                     # a worker finished its run (NOT a barrier: stragglers
                     # keep training; the driver polls done_count to decide
@@ -186,10 +205,19 @@ class ParamService:
                     _send_msg(conn, {"ok": True})
                 elif kind == "bye":
                     _send_msg(conn, {"ok": True})
+                    worker = None        # clean shutdown, never "failed"
                     return
         except (ConnectionError, EOFError, OSError):
+            abnormal = True
             return
         finally:
+            # ONLY an abnormal disconnect marks failure: a server-side
+            # shutdown (_stop) exiting the loop must not condemn a live
+            # worker mid-interaction
+            if abnormal and worker is not None and \
+                    worker not in self.done_workers:
+                with self._lock:
+                    self.failed_workers.add(worker)
             conn.close()
 
     def close(self) -> None:
@@ -227,12 +255,18 @@ class AsyncSSPClient:
                 if time.time() > deadline:
                     raise
                 time.sleep(0.05)
+        # identify BOTH sockets up front: failure detection attributes an
+        # abrupt disconnect to this worker even if it never pushed
+        for sk in (self._push_sock, self._pull_sock):
+            _send_msg(sk, {"kind": "hello", "worker": worker})
+            _recv_msg(sk)
         self._push_lock = threading.Lock()
         self._pull_lock = threading.Lock()
         self._q: "queue.Queue" = queue.Queue()
         self._pending: List[Tuple[int, Dict]] = []  # un-applied own updates
         self._pending_lock = threading.Lock()
         self.clocks: Dict[int, int] = {}
+        self.failed: set = set()   # peers the service declared dead
         self.clock = -1          # last flushed clock
         self._acked_clock = -1   # last clock the server acknowledged
         self.blocked_s = 0.0     # cumulative gate wait (telemetry)
@@ -256,6 +290,7 @@ class AsyncSSPClient:
                                "clock": clock, "delta": delta})
                     ack = _recv_msg(self._push_sock)
                 self.clocks = ack["clocks"]
+                self.failed = set(ack.get("failed", ()))
                 self._acked_clock = clock
             except BaseException as e:  # noqa: BLE001 — surface, never lose
                 # a dead sender must FAIL the run, not silently drop oplogs:
@@ -294,9 +329,11 @@ class AsyncSSPClient:
     def _min_other_clock(self) -> int:
         """A peer we have not heard from yet counts as clock -1 (nothing
         applied), NOT as caught up — otherwise the gate is unenforced
-        until the first ack/refresh arrives."""
+        until the first ack/refresh arrives. FAILED peers are excluded:
+        a dead worker must not deadlock the survivors' gates (elasticity;
+        the reference would abort the whole job here)."""
         others = [self.clocks.get(w, -1) for w in range(self.n_workers)
-                  if w != self.worker]
+                  if w != self.worker and w not in self.failed]
         return min(others) if others else self.clock
 
     def gate(self, clock: int, poll_s: float = 0.01,
@@ -318,7 +355,9 @@ class AsyncSSPClient:
                     f"have {self.clocks} (a peer died?)")
             with self._pull_lock:
                 _send_msg(self._pull_sock, {"kind": "clocks"})
-                self.clocks = _recv_msg(self._pull_sock)["clocks"]
+                resp = _recv_msg(self._pull_sock)
+            self.clocks = resp["clocks"]
+            self.failed = set(resp.get("failed", ()))
             time.sleep(poll_s)
         waited = time.time() - t0
         self.blocked_s += waited
@@ -332,6 +371,7 @@ class AsyncSSPClient:
             _send_msg(self._pull_sock, {"kind": "pull"})
             snap = _recv_msg(self._pull_sock)
         self.clocks = snap["clocks"]
+        self.failed = set(snap.get("failed", ()))
         applied = self.clocks.get(self.worker, -1)
         cache = snap["anchor"]
         with self._pending_lock:
@@ -350,17 +390,24 @@ class AsyncSSPClient:
                                         "worker": self.worker})
             _recv_msg(self._pull_sock)
 
-    def wait_all_done(self, n_workers: int, timeout_s: float = 300.0) -> None:
-        """Poll until every worker reported done (driver-side, rank 0)."""
+    def wait_all_done(self, n_workers: int,
+                      timeout_s: float = 300.0) -> Tuple[set, set]:
+        """Poll until every worker reported done OR was declared failed
+        (driver-side, rank 0). Returns (done, failed) so the caller can
+        SURFACE a lossy run — elasticity keeps the job alive, it must
+        never keep a partial result quiet."""
         t0 = time.time()
         while True:
             with self._pull_lock:
                 _send_msg(self._pull_sock, {"kind": "pull"})
                 snap = _recv_msg(self._pull_sock)
-            if len(snap.get("done", ())) >= n_workers:
-                return
+            done = set(snap.get("done", ()))
+            failed = set(snap.get("failed", ()))
+            if len(done | failed) >= n_workers:
+                return done, failed
             if time.time() - t0 > timeout_s:
-                raise TimeoutError(f"only {snap.get('done')} finished")
+                raise TimeoutError(f"only {sorted(done)} finished "
+                                   f"({sorted(failed)} failed)")
             time.sleep(0.05)
 
     def close(self) -> None:

@@ -103,8 +103,16 @@ class AsyncSSPTier:
                "async_final_clock": float(self.client.clock)}
         if self.service is not None:
             # poll (not barrier) until the stragglers flush their last clock
-            self.client.wait_all_done(self.n_procs)
+            done, failed = self.client.wait_all_done(self.n_procs)
             out["async_max_spread"] = float(self.service.max_spread)
+            if failed:
+                # elasticity keeps the job alive; it must never keep the
+                # loss quiet — the failed workers' un-flushed updates are
+                # simply absent from the anchor
+                out["async_failed_workers"] = sorted(failed)
+                log(f"WARNING: async-SSP workers {sorted(failed)} FAILED "
+                    f"mid-run; anchor holds their applied clocks only",
+                    rank=self.rank)
             # the final anchor is the job's result: fold it into rank 0's
             # params so snapshots/eval see every worker's updates
             engine.params = jax.device_put(

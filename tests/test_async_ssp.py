@@ -218,6 +218,48 @@ def test_digits_convergence_matches_sync():
     assert acc_async >= acc_bsp - 0.005, (acc_async, acc_bsp)
 
 
+def test_worker_crash_does_not_deadlock_survivors():
+    """Elasticity beyond the reference's fail-fast (comm_bus.hpp:22-24
+    aborts the whole job): a worker that dies abruptly (no bye, no done)
+    is detected by the service, excluded from the survivors' gates, and
+    its already-applied clocks stay in the anchor. Without detection the
+    survivor's s=1 gate would TimeoutError waiting on a dead peer."""
+    import time as _time
+
+    from poseidon_tpu.parallel.async_ssp import AsyncSSPClient
+    params = _zeros_params((2, 2))
+    svc = ParamService(params, n_workers=2)
+    one = {"fc": {"w": np.ones((2, 2), np.float32)}}
+    try:
+        # the doomed worker pushes 2 clocks then crashes (sockets torn
+        # down with no bye)
+        doomed = AsyncSSPClient(1, ("127.0.0.1", svc.port), staleness=1,
+                                n_workers=2)
+        doomed.push(one)
+        doomed.push(one)
+        doomed._drain()
+        doomed._stop.set()
+        doomed._sender.join(timeout=5)
+        doomed._push_sock.close()
+        doomed._pull_sock.close()
+        deadline = _time.time() + 10
+        while 1 not in svc.failed_workers and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert 1 in svc.failed_workers
+
+        # the survivor runs 12 clocks at s=1 — far past the dead peer's
+        # clock 1 — and must never block on it
+        res = run_async_ssp_worker(
+            0, 2, params, _counting_step(0), 12, staleness=1, service=svc)
+        assert res["final_clock"] == 11
+        # anchor = survivor's 12 + dead worker's 2 applied clocks
+        np.testing.assert_allclose(svc.anchor["fc"]["w"],
+                                   np.full((2, 2), 14.0))
+        assert svc.done_workers == {0}
+    finally:
+        svc.close()
+
+
 @pytest.mark.slow
 @pytest.mark.skipif(not os.path.isdir(
     os.path.join(REPO, "examples/mnist/mnist_train_lmdb")),
