@@ -110,12 +110,39 @@ class ParamService:
 
     Applies PUSH increments the moment they arrive (no epoch, no barrier)
     and serves PULL snapshots at whatever clock vector the moment holds —
-    the server side of Bösen's wait-free contract. ``server_logic="inc"``
-    is the reference's plain additive oplog apply."""
+    the server side of Bösen's wait-free contract.
+
+    ``server_logic``:
+      - ``"inc"`` (default): plain additive oplog apply — the reference's
+        SSPPush increment rule; pushes carry pre-scaled parameter deltas.
+      - ``"adarevision"``: the delay-corrected AdaGrad server rule
+        (adarevision_server_table_logic.cpp:52-175), living HERE in its
+        native habitat — the asynchronous tier it was designed for (the
+        compiled tier's version is boundary-aligned; this one computes the
+        true cross-boundary backlog). Pushes carry RAW accumulated
+        gradients u based on the worker's last PULL snapshot; per element:
+        ``g_bck = G - G_base[w]``; ``z += u*(u + 2*g_bck)``;
+        ``zmax = max(zmax, z)``; ``eta = init_step/sqrt(zmax)``;
+        ``anchor += -eta*u + (eta_old - eta)*g_bck``; ``G += u``; a PULL
+        re-bases ``G_base[w] = G``."""
 
     def __init__(self, params: Dict, n_workers: int,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 server_logic: str = "inc", init_step: float = 0.1):
+        if server_logic not in ("inc", "adarevision"):
+            raise ValueError(f"unknown server_logic {server_logic!r}")
         self.anchor = _tree_copy(params)
+        self.server_logic = server_logic
+        self.init_step = init_step
+        if server_logic == "adarevision":
+            ones = {l: {p: np.ones_like(v) for p, v in ps.items()}
+                    for l, ps in self.anchor.items()}
+            zeros = {l: {p: np.zeros_like(v) for p, v in ps.items()}
+                     for l, ps in self.anchor.items()}
+            self.z = _tree_copy(ones)        # AdaRevisionRow ctor: init 1
+            self.zmax = _tree_copy(ones)
+            self.gsum = _tree_copy(zeros)    # total raw gradient applied
+            self.gbase = {w: _tree_copy(zeros) for w in range(n_workers)}
         self.clocks = {w: -1 for w in range(n_workers)}  # applied clocks
         self.n_workers = n_workers
         self._lock = threading.Lock()
@@ -166,7 +193,11 @@ class ParamService:
                     _send_msg(conn, {"ok": True})
                 elif kind == "push":
                     with self._lock:
-                        _tree_add(self.anchor, msg["delta"])
+                        if self.server_logic == "adarevision":
+                            self._apply_adarevision(msg["worker"],
+                                                    msg["delta"])
+                        else:
+                            _tree_add(self.anchor, msg["delta"])
                         self.clocks[msg["worker"]] = msg["clock"]
                         self._version += 1
                         cs = [c for w, c in self.clocks.items()
@@ -188,14 +219,19 @@ class ParamService:
                         done = sorted(self.done_workers)
                         failed = sorted(self.failed_workers)
                         version = self._version
+                        if self.server_logic == "adarevision" and \
+                                worker is not None:
+                            # the read re-bases this worker's backlog: its
+                            # next gradients build on THIS snapshot
+                            self.gbase[worker] = _tree_copy(self.gsum)
                     _send_msg(conn, {"anchor": snap, "clocks": clocks,
                                      "done": done, "failed": failed,
                                      "version": version})
                 elif kind == "clocks":
                     with self._lock:
-                        _send_msg(conn, {"clocks": dict(self.clocks),
-                                         "failed":
-                                             sorted(self.failed_workers)})
+                        clocks = dict(self.clocks)
+                        failed = sorted(self.failed_workers)
+                    _send_msg(conn, {"clocks": clocks, "failed": failed})
                 elif kind == "done":
                     # a worker finished its run (NOT a barrier: stragglers
                     # keep training; the driver polls done_count to decide
@@ -220,6 +256,21 @@ class ParamService:
                     self.failed_workers.add(worker)
             conn.close()
 
+    def _apply_adarevision(self, worker: int, u: Dict) -> None:
+        """The reference server rule, per element (caller holds the lock;
+        adarevision_server_table_logic.cpp:52-175; exact-formula test:
+        tests/test_async_ssp.py::test_adarevision_matches_reference_formula)."""
+        for l, ps in u.items():
+            for p, ug in ps.items():
+                g_bck = self.gsum[l][p] - self.gbase[worker][l][p]
+                eta_old = self.init_step / np.sqrt(self.zmax[l][p])
+                self.z[l][p] += ug * (ug + 2.0 * g_bck)
+                np.maximum(self.zmax[l][p], self.z[l][p],
+                           out=self.zmax[l][p])
+                eta = self.init_step / np.sqrt(self.zmax[l][p])
+                self.anchor[l][p] += -eta * ug + (eta_old - eta) * g_bck
+                self.gsum[l][p] += ug
+
     def close(self) -> None:
         self._stop.set()
         try:
@@ -241,10 +292,13 @@ class AsyncSSPClient:
 
     def __init__(self, worker: int, addr: Tuple[str, int],
                  staleness: int, n_workers: int = 0,
-                 retry_s: float = 10.0):
+                 retry_s: float = 10.0, server_logic: str = "inc",
+                 init_step: float = 0.1):
         self.worker = worker
         self.n_workers = n_workers if n_workers else worker + 1
         self.staleness = staleness
+        self.server_logic = server_logic
+        self.init_step = init_step
         deadline = time.time() + retry_s
         while True:
             try:
@@ -366,7 +420,15 @@ class AsyncSSPClient:
     # ---- cache refresh (read-my-writes) --------------------------------- #
     def refresh(self) -> Tuple[Dict, Dict[int, int]]:
         """Pull the anchor and rebuild the local cache as
-        anchor + own-pending-updates-not-yet-applied-by-the-server."""
+        anchor + own-pending-updates-not-yet-applied-by-the-server.
+
+        adarevision mode drains the push queue FIRST: the pull re-bases
+        this worker's backlog snapshot at the server (gbase), which is
+        only correct once every earlier push has been applied — and the
+        pending rebuild scales raw gradients by -init_step (the client-lr
+        preview), never adds them raw."""
+        if self.server_logic == "adarevision":
+            self._drain()
         with self._pull_lock:
             _send_msg(self._pull_sock, {"kind": "pull"})
             snap = _recv_msg(self._pull_sock)
@@ -377,7 +439,17 @@ class AsyncSSPClient:
         with self._pending_lock:
             self._pending = [(c, d) for c, d in self._pending if c > applied]
             for _, d in self._pending:
-                _tree_add(cache, d)
+                if self.server_logic == "adarevision":
+                    # pending entries are RAW gradients: preview them at
+                    # the client-lr estimate, exactly as the worker loop
+                    # advanced its cache (normally empty here — the drain
+                    # above leaves pendings only after its timeout)
+                    for l, ps in d.items():
+                        for pn, gv in ps.items():
+                            cache[l][pn] = cache[l][pn] - \
+                                self.init_step * gv
+                else:
+                    _tree_add(cache, d)
         return cache, dict(self.clocks)
 
     def mark_done(self) -> None:
@@ -444,19 +516,32 @@ def run_async_ssp_worker(
     sync_every: int = 1,
     refresh_every: int = 1,
     slow_s: float = 0.0,
+    server_logic: str = "inc",
+    init_step: float = 0.1,
 ) -> Dict:
     """Drive one worker through ``n_clocks`` flush clocks.
 
-    ``local_step(cache_params, step_index) -> (new_params, loss)`` is the
-    process-local compiled step (any intra-process parallelism stays inside
-    it); this driver owns only the DCN-tier exchange: gate -> step(s) ->
-    push increment -> refresh. ``slow_s`` injects per-clock straggler delay
-    (test harness). Returns the final cache + telemetry."""
+    ``server_logic="inc"`` (default): ``local_step(cache, step_index) ->
+    (new_params, loss)`` is the process-local compiled step; the flushed
+    increment is the parameter delta it produced.
+
+    ``server_logic="adarevision"``: ``local_step(cache, step_index) ->
+    (grads, loss)`` returns RAW gradients; the flush carries their sum and
+    the SERVER owns the learning rate (the delay-corrected AdaGrad rule).
+    The local preview advances by ``-init_step * grads`` — the client-side
+    lr estimate the reference's process storage uses between refreshes;
+    every refresh replaces it with the server's revised view.
+
+    This driver owns only the DCN-tier exchange: gate -> step(s) -> push ->
+    refresh. ``slow_s`` injects per-clock straggler delay (test harness).
+    Returns the final cache + telemetry."""
     if service is not None:
         addr = ("127.0.0.1", service.port)
     else:
         addr = service_addr
-    cli = AsyncSSPClient(worker, addr, staleness, n_workers=n_workers)
+    cli = AsyncSSPClient(worker, addr, staleness, n_workers=n_workers,
+                         server_logic=server_logic, init_step=init_step)
+    adarev = server_logic == "adarevision"
     cache = _tree_copy(params)
     losses = []
     t_start = time.time()
@@ -465,11 +550,26 @@ def run_async_ssp_worker(
             cli.gate(clock)
             if slow_s:
                 time.sleep(slow_s)
-            before = _tree_copy(cache)
-            for k in range(sync_every):
-                cache, loss = local_step(cache, clock * sync_every + k)
-            losses.append(float(loss))
-            cli.push(_tree_sub(cache, before))
+            if adarev:
+                u = None
+                for k in range(sync_every):
+                    g, loss = local_step(cache, clock * sync_every + k)
+                    if u is None:
+                        u = _tree_copy(g)
+                    else:
+                        _tree_add(u, g)
+                    for l, ps in g.items():
+                        for p, gv in ps.items():
+                            cache[l][p] = cache[l][p] - init_step * gv
+                losses.append(float(loss))
+                cli.push(u)
+            else:
+                before = _tree_copy(cache)
+                for k in range(sync_every):
+                    cache, loss = local_step(cache,
+                                             clock * sync_every + k)
+                losses.append(float(loss))
+                cli.push(_tree_sub(cache, before))
             if (clock + 1) % refresh_every == 0:
                 cache, _ = cli.refresh()
         wall = time.time() - t_start

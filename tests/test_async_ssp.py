@@ -353,3 +353,110 @@ def test_two_process_wait_free():
     # the straggler slept 40 x 30 ms; the fast rank must not have paid it
     assert fast["wall_s"] < 0.6 * slow["wall_s"], (fast, slow)
     assert fast["accuracy"] > 0.9, fast
+
+
+def test_adarevision_matches_reference_formula():
+    """server_logic='adarevision' on the ASYNC service must reproduce the
+    reference server's rule exactly (adarevision_server_table_logic.cpp:
+    52-175) — including the cross-boundary backlog the compiled tier
+    cannot express: worker 1 pushes a gradient based on a PULL taken
+    before worker 0's second push, so its g_bck covers exactly the
+    updates applied since that snapshot. Verified against a float64
+    NumPy replica driven through the same (push, pull) interleaving."""
+    from poseidon_tpu.parallel.async_ssp import AsyncSSPClient
+    eta0 = 0.05
+    rs = np.random.RandomState(0)
+    params = {"fc": {"w": rs.randn(3, 2).astype(np.float32)}}
+    svc = ParamService(params, n_workers=2, server_logic="adarevision",
+                       init_step=eta0)
+    c0 = AsyncSSPClient(0, ("127.0.0.1", svc.port), staleness=10,
+                        n_workers=2)
+    c1 = AsyncSSPClient(1, ("127.0.0.1", svc.port), staleness=10,
+                        n_workers=2)
+    u = [rs.randn(3, 2).astype(np.float32) for _ in range(4)]
+
+    def push(cli, g):
+        cli.push({"fc": {"w": g}})
+        cli._drain()
+
+    try:
+        c1.refresh()                    # worker 1 bases at G = 0
+        push(c0, u[0])                  # applied: u0
+        push(c0, u[1])                  # applied: u0+u1
+        push(c1, u[2])                  # based at 0 -> g_bck = u0+u1
+        c0.refresh()                    # worker 0 re-bases at G = u0+u1+u2
+        push(c0, u[3])                  # g_bck = 0 (nothing since its pull)
+        got = np.asarray(svc.anchor["fc"]["w"], np.float64)
+    finally:
+        c0.close()
+        c1.close()
+        svc.close()
+
+    # float64 replica of the exact server rule
+    av = np.asarray(params["fc"]["w"], np.float64)
+    z = np.ones_like(av)
+    zmax = np.ones_like(av)
+    G = np.zeros_like(av)
+    base = {0: np.zeros_like(av), 1: np.zeros_like(av)}
+    order = [(0, u[0]), (0, u[1]), (1, u[2])]
+    for w, ug in order:
+        ug = np.asarray(ug, np.float64)
+        g_bck = G - base[w]
+        eta_old = eta0 / np.sqrt(zmax)
+        z = z + ug * (ug + 2.0 * g_bck)
+        zmax = np.maximum(zmax, z)
+        eta = eta0 / np.sqrt(zmax)
+        av = av - eta * ug + (eta_old - eta) * g_bck
+        G = G + ug
+    base[0] = G.copy()                  # c0.refresh()
+    ug = np.asarray(u[3], np.float64)
+    g_bck = G - base[0]
+    eta_old = eta0 / np.sqrt(zmax)
+    z = z + ug * (ug + 2.0 * g_bck)
+    zmax = np.maximum(zmax, z)
+    eta = eta0 / np.sqrt(zmax)
+    av = av - eta * ug + (eta_old - eta) * g_bck
+    np.testing.assert_allclose(got, av, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_adarevision_digits_converges():
+    """AdaRevision on the async tier end to end: 2 workers (one straggler)
+    pushing raw gradients, the server owning the delay-corrected lr —
+    digits accuracy must reach the same ballpark as the additive tier."""
+    (Xtr, ytr), (Xte, yte) = _digits()
+    half = len(Xtr) // 2
+    shards = [(Xtr[:half], ytr[:half]), (Xtr[half:], ytr[half:])]
+
+    def grad_step(w):
+        X, y = shards[w]
+        n = len(X)
+
+        def step(params, it):
+            rs = np.random.RandomState(it)
+            sel = rs.randint(0, n, size=128)
+            xb, yb = X[sel], y[sel]
+            W = params["fc"]["w"]
+            logits = xb @ W
+            logits -= logits.max(axis=1, keepdims=True)
+            p = np.exp(logits)
+            p /= p.sum(axis=1, keepdims=True)
+            loss = -np.log(p[np.arange(128), yb] + 1e-9).mean()
+            p[np.arange(128), yb] -= 1.0
+            return {"fc": {"w": xb.T @ p / 128}}, loss
+        return step
+
+    W0 = {"fc": {"w": np.zeros((64, 10), np.float32)}}
+    svc = ParamService(W0, n_workers=2, server_logic="adarevision",
+                       init_step=0.3)
+    try:
+        _run_workers(2, staleness=2, n_clocks=150, slow_map={1: 0.002},
+                     service=svc, params=W0, step_fn=grad_step,
+                     sync_every=4, server_logic="adarevision",
+                     init_step=0.3)
+        acc = _accuracy(svc.anchor["fc"]["w"], Xte, yte)
+        spread = svc.max_spread
+    finally:
+        svc.close()
+    assert spread <= 3
+    assert acc > 0.92, acc
