@@ -297,14 +297,20 @@ random_seed: 7
     rc, raw_logs = launch.launch_local(
         2, 4, port,
         ["train", "--solver", str(solver), "--async_ssp",
-         "--staleness", "2",
+         "--staleness", "2", "--steps_per_dispatch", "3",
          "--output_dir", str(tmp_path / "p{proc_id}")],
         capture=True)
     logs = [b.decode() for b in raw_logs]
     assert rc == 0, logs[0][-2000:] + logs[1][-2000:]
     assert "async-SSP tier: 2 workers" in logs[0]
     assert "Iteration 10" in logs[0]
-    assert "async_final_clock=11.0" in logs[0], logs[0][-800:]
+    # chunked dispatch (steps_per_dispatch=3): one flush clock per
+    # dispatch, so the final clock is dispatch-count-1 (display/test
+    # boundaries make the chunking pattern data-dependent — assert the
+    # tier ran and flushed repeatedly, not an exact count)
+    import re as _re
+    m = _re.search(r"async_final_clock=(\d+)", logs[0])
+    assert m and int(m.group(1)) >= 3, logs[0][-800:]
     # rank 0's post-train snapshot holds the final ANCHOR (all workers'
     # updates folded in), written through the standard snapshot path
     import numpy as np_
