@@ -320,6 +320,50 @@ random_seed: 7
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(not os.path.isdir(
+    os.path.join(REPO, "examples/mnist/mnist_train_lmdb")),
+    reason="synthetic MNIST LMDB not generated")
+def test_cli_async_ssp_composes_with_intra_process_strategies(tmp_path):
+    """The full two-tier async deployment: each process runs a compiled
+    4-device step with SFB on its FC layers (the per-step backward-time
+    ICI exchange), while the wait-free service carries the cross-process
+    tier — the reference's machine-internal-PS + inter-machine-Bösen
+    split, with the inner tier compiled."""
+    scripts = os.path.join(REPO, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import launch
+
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f"""
+net: "{REPO}/examples/mnist/lenet_train_test.prototxt"
+base_lr: 0.01
+lr_policy: "fixed"
+momentum: 0.9
+display: 4
+max_iter: 8
+test_interval: 0
+random_seed: 11
+""")
+    (tmp_path / "p0").mkdir()
+    (tmp_path / "p1").mkdir()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rc, raw_logs = launch.launch_local(
+        2, 4, port,
+        ["train", "--solver", str(solver), "--async_ssp",
+         "--staleness", "1", "--sfb-auto",
+         "--output_dir", str(tmp_path / "p{proc_id}")],
+        capture=True)
+    logs = [b.decode() for b in raw_logs]
+    assert rc == 0, logs[0][-2000:] + logs[1][-2000:]
+    assert "async-SSP tier: 2 workers" in logs[0]
+    assert "Iteration 8" in logs[0] or "Iteration 4" in logs[0]
+
+
+@pytest.mark.slow
 def test_two_process_wait_free():
     """The deployment shape: 2 REAL processes through scripts/launch.py
     --local, rank 0 hosting the ParamService, rank 1 an artificial
