@@ -8,9 +8,13 @@ per 128-row tile). The backward pass is likewise Pallas and O(S) in HBM: the
 dq and dk/dv kernels below recompute scores blockwise from the saved
 (out, logsumexp) residuals, wired up via ``defvjp``.
 
-``lrn_fused``: cross-channel LRN forward in one VMEM pass — x^2, the
-channel-window running sum, pow, and the product fused per (H*W)-tile, saving
-the intermediate HBM round-trips of the unfused op on pre-fusion XLA.
+``lrn_fused`` / ``lrn_fused_bwd``: cross-channel LRN in one VMEM pass per
+(H*W)-tile, forward and analytic backward. NOT the default path: the
+round-5 TPU cost-model A/B found the custom-call boundary copies cost more
+than the fused XLA chain (evidence/aot_tpu/layer_cycles.json), so
+``maybe_lrn_fused`` routes to XLA unless ``POSEIDON_PALLAS_LRN=1`` — the
+kernels stay Mosaic-validated (tests/test_aot_tpu.py) for the live-chip
+wall-clock A/B that could overrule the model.
 
 Kernels run in interpret mode off-TPU so the CPU test mesh exercises the same
 code path.
