@@ -469,6 +469,37 @@ def section_lm_gpt_small(topo) -> dict:
                 "mfu_6pt": round(model_flops / dt / peak, 4)}
     print(f"[aot]   gpt_small: {cycles} est cycles, "
           f"{flops / 1e12:.2f} TF/step", flush=True)
+
+    # Megatron tp at the REAL size (the per-mode tables use toy configs):
+    # dp2 x tp4 over the v5e-8, same gpt_small shape — records the f/g
+    # psum bytes an 8-chip pod would move per step
+    from analyze_schedule import analyze_module
+    from poseidon_tpu.models.transformer import (build_dp_tp_train_step,
+                                                 to_tp_layout)
+    from poseidon_tpu.runtime.hlo_comm import (measured_comm_summary,
+                                               parse_collectives)
+    mesh8 = _mesh(topo, ("data", "model"), (2, 4))
+    with pconfig.policy_scope(compute_dtype=jnp.bfloat16):
+        lp_tp = to_tp_layout(init_params(cfg, jax.random.PRNGKey(0)), cfg)
+        step_tp = build_dp_tp_train_step(cfg, sp, mesh8, lp_tp,
+                                         donate=False)
+        ls_tp = init_state(lp_tp)
+        toks8 = jnp.asarray(rs.randint(0, cfg.vocab_size, (2 * batch, seq),
+                                       dtype=np.int32))
+        t0 = time.time()
+        txt_tp = step_tp.lower(lp_tp, ls_tp, toks8, toks8,
+                               jax.random.PRNGKey(1)).compile().as_text()
+    r = analyze_module(txt_tp)
+    out["dp2_tp4"] = {
+        "collectives_by_kind": r["collectives_by_kind"],
+        "comm_bytes": measured_comm_summary(parse_collectives(txt_tp)),
+        "est_cycles": sum(int(m) for m in _re.findall(
+            r'"estimated_cycles":"(\d+)"', txt_tp)),
+        "compile_seconds": round(time.time() - t0, 1)}
+    print(f"[aot]   gpt_small dp2_tp4: "
+          f"{out['dp2_tp4']['collectives_by_kind']}, "
+          f"{out['dp2_tp4']['comm_bytes']['measured_bytes_per_step']} "
+          f"bytes/step", flush=True)
     return out
 
 
