@@ -1,47 +1,73 @@
-"""Global numeric policy (the TPU analog of Caffe's Dtype template parameter).
+"""Global configuration: numeric policy and fault-tolerance policy.
 
-Parameters and optimizer state stay float32. Forward/backward matmul and conv
-inputs are cast to ``compute_dtype`` (bfloat16 for TPU perf configs; the MXU
-accumulates bf16 products in f32 internally) and produce compute-dtype
-activations — forcing f32 outputs via preferred_element_type breaks conv
-transposes under autodiff, so it is used only where autodiff never looks:
-custom_vjp backward dots (SFB gradient reconstruction) and softmax/online-
-softmax statistics, which are always f32 (``accum_dtype``). Set compute dtype
-to float32 (the default) for Caffe-parity numerics; matmul precision is then
-forced to HIGHEST (see ``matmul_precision``).
+The numeric policy (the TPU analog of Caffe's Dtype template parameter)
+lives in ``poseidon_tpu.numeric`` and is re-exported here lazily: the
+socket-tier processes (async-SSP workers spawned per host, the fault
+proxy, a ParamService-only rank) import ``poseidon_tpu`` at startup, and
+an eager ``import jax.numpy`` here would cost them multi-second process
+startup that reads as silence to the service's liveness monitor. Anything
+jax-side keeps its spelling — ``config.policy()``,
+``from ..config import matmul_precision`` — and pays the jax import on
+first touch, which for jax-side code has already happened.
+
+The fault-tolerance policy (``FaultConfig``) is eager and dependency-free.
 """
 
 from __future__ import annotations
 
-from contextlib import contextmanager
 from dataclasses import dataclass
 
-import jax.numpy as jnp
+# Numeric-policy names re-exported from poseidon_tpu.numeric via the
+# module __getattr__ below (PEP 562).
+_NUMERIC_NAMES = frozenset({
+    "Policy", "policy", "set_policy", "policy_scope", "matmul_precision",
+})
+
+
+def __getattr__(name):
+    if name in _NUMERIC_NAMES:
+        from . import numeric
+        return getattr(numeric, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 @dataclass
-class Policy:
-    param_dtype: object = jnp.float32
-    compute_dtype: object = jnp.float32  # flipped to bfloat16 by perf configs
-    accum_dtype: object = jnp.float32
-    # Internal conv layout. The external/prototxt contract is always NCHW
-    # (Caffe blobs); "NHWC" transposes around each conv so XLA sees the
-    # TPU-preferred channels-last layout — the transposes sit at op
-    # boundaries where XLA's layout assignment can cancel chains of them.
-    conv_layout: str = "NCHW"
-    # Space-to-depth stem transform: rewrite few-channel strided convs
-    # (AlexNet/GoogLeNet conv1: 3 input channels use 3/128 MXU lanes) as an
-    # exact stride-1 conv over s*s-times more channels. Mathematically
-    # exact up to float summation order; off by default so golden-value
-    # tests compare the direct formulation.
-    conv_s2d: bool = False
+class FaultConfig:
+    """Fault-tolerance policy for the host-driven async-SSP process tier.
+
+    The reference is fail-fast (comm_bus.hpp:22-24: any connection error
+    aborts the job); TPU pods preempt routinely, so the tier instead runs a
+    liveness protocol: clients heartbeat on the push channel, the service
+    evicts workers silent past the timeout (survivors' gates unblock), and
+    clients reconnect with capped exponential backoff + full jitter,
+    replaying un-acked flushes (the service dedups by per-worker sequence
+    number, so a retried flush applies exactly once)."""
+
+    # client -> service heartbeat cadence (sent when the push queue is idle)
+    heartbeat_s: float = 1.0
+    # service evicts a worker not heard from for this long; <= 0 disables
+    # eviction (the reference's hang-forever gate semantics)
+    liveness_timeout_s: float = 30.0
+    # client gives up reconnecting (and surfaces permanent failure to the
+    # training loop) after this long without a successful attempt
+    reconnect_deadline_s: float = 30.0
+    # backoff envelope: sleep ~ U(0, min(cap, base * 2**attempt))
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
 
 
-_policy = Policy()
+_fault = FaultConfig()
 
 
-def policy() -> Policy:
-    return _policy
+def fault_config() -> FaultConfig:
+    return _fault
+
+
+def set_fault_config(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_fault, k):
+            raise AttributeError(k)
+        setattr(_fault, k, v)
 
 
 def enable_tpu_async_collectives() -> bool:
@@ -74,29 +100,3 @@ def enable_tpu_async_collectives() -> bool:
             pass
     os.environ["LIBTPU_INIT_ARGS"] = (cur + " " + flags).strip()
     return True
-
-
-def matmul_precision():
-    """float32 compute means Caffe-parity numerics: force exact f32 passes.
-    bfloat16 compute means MXU-native: let XLA use its fast default."""
-    import jax.lax
-    if _policy.compute_dtype == jnp.float32:
-        return jax.lax.Precision.HIGHEST
-    return jax.lax.Precision.DEFAULT
-
-
-def set_policy(**kwargs) -> None:
-    for k, v in kwargs.items():
-        if not hasattr(_policy, k):
-            raise AttributeError(k)
-        setattr(_policy, k, v)
-
-
-@contextmanager
-def policy_scope(**kwargs):
-    saved = {k: getattr(_policy, k) for k in kwargs}
-    set_policy(**kwargs)
-    try:
-        yield
-    finally:
-        set_policy(**saved)

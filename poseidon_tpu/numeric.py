@@ -1,0 +1,74 @@
+"""Global numeric policy (the TPU analog of Caffe's Dtype template parameter).
+
+Parameters and optimizer state stay float32. Forward/backward matmul and conv
+inputs are cast to ``compute_dtype`` (bfloat16 for TPU perf configs; the MXU
+accumulates bf16 products in f32 internally) and produce compute-dtype
+activations — forcing f32 outputs via preferred_element_type breaks conv
+transposes under autodiff, so it is used only where autodiff never looks:
+custom_vjp backward dots (SFB gradient reconstruction) and softmax/online-
+softmax statistics, which are always f32 (``accum_dtype``). Set compute dtype
+to float32 (the default) for Caffe-parity numerics; matmul precision is then
+forced to HIGHEST (see ``matmul_precision``).
+
+This module owns the jax dependency; ``config`` re-exports everything here
+lazily so the socket-tier processes (async-SSP workers, the fault proxy)
+can import ``poseidon_tpu`` without paying the jax import.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+@dataclass
+class Policy:
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.float32  # flipped to bfloat16 by perf configs
+    accum_dtype: object = jnp.float32
+    # Internal conv layout. The external/prototxt contract is always NCHW
+    # (Caffe blobs); "NHWC" transposes around each conv so XLA sees the
+    # TPU-preferred channels-last layout — the transposes sit at op
+    # boundaries where XLA's layout assignment can cancel chains of them.
+    conv_layout: str = "NCHW"
+    # Space-to-depth stem transform: rewrite few-channel strided convs
+    # (AlexNet/GoogLeNet conv1: 3 input channels use 3/128 MXU lanes) as an
+    # exact stride-1 conv over s*s-times more channels. Mathematically
+    # exact up to float summation order; off by default so golden-value
+    # tests compare the direct formulation.
+    conv_s2d: bool = False
+
+
+_policy = Policy()
+
+
+def policy() -> Policy:
+    return _policy
+
+
+def matmul_precision():
+    """float32 compute means Caffe-parity numerics: force exact f32 passes.
+    bfloat16 compute means MXU-native: let XLA use its fast default."""
+    import jax.lax
+    if _policy.compute_dtype == jnp.float32:
+        return jax.lax.Precision.HIGHEST
+    return jax.lax.Precision.DEFAULT
+
+
+def set_policy(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_policy, k):
+            raise AttributeError(k)
+        setattr(_policy, k, v)
+
+
+@contextmanager
+def policy_scope(**kwargs):
+    saved = {k: getattr(_policy, k) for k in kwargs}
+    set_policy(**kwargs)
+    try:
+        yield
+    finally:
+        set_policy(**saved)

@@ -1,13 +1,41 @@
-from .mesh import DATA_AXIS, batch_sharding, make_mesh, replicated  # noqa: F401
-from .strategies import (  # noqa: F401
-    CommConfig, CommContext, DENSE, DENSE_FUSED, LOCAL, SFB, TOPK,
-    auto_strategies, topk_compress,
-)
-from .trainer import (  # noqa: F401
-    SSPState, TrainState, build_eval_step, build_ssp_train_step,
-    build_train_step, comm_error_groups, init_comm_error, init_ssp_state,
-    init_train_state, param_mults, reconcile_comm_error, stack_batches,
-)
-from .sequence import (  # noqa: F401
-    ring_attention, ring_flash_attention, ulysses_attention,
-)
+"""Parallel strategies package.
+
+Re-exports resolve lazily (PEP 562): the host-driven async-SSP tier
+(``parallel.async_ssp``) is plain sockets + numpy, and the worker
+processes that import it must not pay the jax import an eager
+``from .trainer import ...`` here would force — multi-second process
+startup reads as silence to the service's liveness monitor.
+"""
+
+_LAZY = {
+    # mesh
+    "DATA_AXIS": "mesh", "batch_sharding": "mesh", "make_mesh": "mesh",
+    "replicated": "mesh",
+    # strategies
+    "CommConfig": "strategies", "CommContext": "strategies",
+    "DENSE": "strategies", "DENSE_FUSED": "strategies",
+    "LOCAL": "strategies", "SFB": "strategies", "TOPK": "strategies",
+    "auto_strategies": "strategies", "topk_compress": "strategies",
+    # trainer
+    "SSPState": "trainer", "TrainState": "trainer",
+    "build_eval_step": "trainer", "build_ssp_train_step": "trainer",
+    "build_train_step": "trainer", "comm_error_groups": "trainer",
+    "init_comm_error": "trainer", "init_ssp_state": "trainer",
+    "init_train_state": "trainer", "param_mults": "trainer",
+    "reconcile_comm_error": "trainer", "stack_batches": "trainer",
+    # sequence
+    "ring_attention": "sequence", "ring_flash_attention": "sequence",
+    "ulysses_attention": "sequence",
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    from importlib import import_module
+    return getattr(import_module(f".{mod_name}", __name__), name)

@@ -38,9 +38,37 @@ Design (the Bösen pieces, re-homed):
 A "clock" is one flush (``sync_every`` optimizer steps), matching the
 reference's per-iteration oplog flush granularity.
 
+Fault tolerance (beyond the reference's fail-fast, comm_bus.hpp:22-24 —
+any connection error there aborts the whole job; TPU pods preempt workers
+routinely, so this tier survives them instead):
+
+- liveness: clients heartbeat on the push channel whenever the flush queue
+  is idle; the service EVICTS a worker silent past
+  ``liveness_timeout_s`` (and, faster, on an abrupt disconnect of its last
+  connection). Evicted workers leave the survivors' read gates — ``gate()``
+  on survivors unblocks instead of hanging on a dead peer's clock forever.
+  The evicted worker's already-applied clocks stay in the anchor; the
+  bounded update loss is exactly its un-flushed oplog (the PS failure
+  model).
+- reconnect: a client whose channel dies redials with capped exponential
+  backoff + full jitter (``runtime/retry.py``) and REPLAYS every un-acked
+  flush. Every PUSH carries a per-worker sequence number and the service
+  keeps the high-water mark, so a replayed flush whose ack was lost is
+  applied exactly once. Any service-side activity from an evicted worker
+  un-evicts it (rejoin).
+- rejoin: a restarted worker process calls :meth:`AsyncSSPClient.rejoin` —
+  pull the anchor, re-seed the local cache from it, resume at the anchor's
+  recorded clock for this worker.
+- permanent failure surfaces: when the reconnect deadline is exhausted the
+  sender thread records the error and every subsequent ``push``/``gate``/
+  ``refresh`` raises it into the training loop — a run never silently
+  drops oplogs behind a dead thread.
+
 Wire format: length-prefixed pickles of numpy pytrees over TCP on the
 launcher's control network (trusted, same trust domain as
-jax.distributed's own channel).
+jax.distributed's own channel). A malformed or truncated frame never kills
+the service: the offending connection is logged and dropped
+(:class:`FrameError`), everyone else keeps training.
 """
 
 from __future__ import annotations
@@ -48,6 +76,7 @@ from __future__ import annotations
 import io
 import pickle
 import queue
+import random
 import socket
 import struct
 import threading
@@ -56,12 +85,37 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["ParamService", "AsyncSSPClient", "run_async_ssp_worker"]
+__all__ = ["ParamService", "AsyncSSPClient", "run_async_ssp_worker",
+           "FrameError"]
+
+
+def _log(msg: str) -> None:
+    # runtime/metrics.log, imported lazily: parallel/ must not pull the
+    # whole runtime package (engine, jax) in at import time
+    try:
+        from ..runtime.metrics import log as _rlog
+    except Exception:  # noqa: BLE001 — logging must never take the tier down
+        print(msg, flush=True)
+        return
+    _rlog(msg)
 
 
 # --------------------------------------------------------------------------- #
 # framing
 # --------------------------------------------------------------------------- #
+
+class FrameError(ConnectionError):
+    """Malformed or truncated wire frame (mid-message EOF, oversized
+    length, undecodable pickle). A ConnectionError subclass so client
+    recovery treats it like any other dead-channel signal, while the
+    service can log it distinctly instead of dying in the handler."""
+
+
+# A garbage 8-byte header read as a length is astronomically large (ASCII
+# bytes decode to ~10^16); cap frames so it fails fast as a FrameError
+# instead of an attempted multi-petabyte recv.
+_MAX_FRAME = 1 << 32
+
 
 def _send_msg(sock: socket.socket, obj) -> None:
     buf = io.BytesIO()
@@ -72,18 +126,33 @@ def _send_msg(sock: socket.socket, obj) -> None:
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
-    while n:
-        c = sock.recv(min(n, 1 << 20))
+    want = n
+    while want:
+        c = sock.recv(min(want, 1 << 20))
         if not c:
-            raise ConnectionError("peer closed")
+            if want == n:
+                raise ConnectionError("peer closed")
+            raise FrameError(f"mid-message EOF ({n - want}/{n} bytes)")
         chunks.append(c)
-        n -= len(c)
+        want -= len(c)
     return b"".join(chunks)
 
 
 def _recv_msg(sock: socket.socket):
     (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    if n > _MAX_FRAME:
+        raise FrameError(f"frame length {n} exceeds cap {_MAX_FRAME}")
+    try:
+        payload = _recv_exact(sock, n)
+    except FrameError:
+        raise
+    except ConnectionError as e:
+        # header arrived, payload did not: mid-message, not a clean close
+        raise FrameError(f"mid-message EOF in payload ({e})") from e
+    try:
+        return pickle.loads(payload)
+    except Exception as e:  # noqa: BLE001 — any undecodable payload
+        raise FrameError(f"bad frame payload: {type(e).__name__}: {e}") from e
 
 
 def _tree_add(a: Dict, b: Dict) -> None:
@@ -99,6 +168,22 @@ def _tree_sub(a: Dict, b: Dict) -> Dict:
 
 def _tree_copy(a: Dict) -> Dict:
     return {l: {p: np.array(v) for p, v in ps.items()} for l, ps in a.items()}
+
+
+def _fault_defaults(heartbeat_s, liveness_timeout_s, reconnect_deadline_s,
+                    backoff_base_s, backoff_cap_s):
+    """Resolve None knobs against the global FaultConfig (config.py)."""
+    from .. import config as _config
+    fc = _config.fault_config()
+    return (
+        fc.heartbeat_s if heartbeat_s is None else heartbeat_s,
+        fc.liveness_timeout_s if liveness_timeout_s is None
+        else liveness_timeout_s,
+        fc.reconnect_deadline_s if reconnect_deadline_s is None
+        else reconnect_deadline_s,
+        fc.backoff_base_s if backoff_base_s is None else backoff_base_s,
+        fc.backoff_cap_s if backoff_cap_s is None else backoff_cap_s,
+    )
 
 
 # --------------------------------------------------------------------------- #
@@ -124,11 +209,20 @@ class ParamService:
         ``g_bck = G - G_base[w]``; ``z += u*(u + 2*g_bck)``;
         ``zmax = max(zmax, z)``; ``eta = init_step/sqrt(zmax)``;
         ``anchor += -eta*u + (eta_old - eta)*g_bck``; ``G += u``; a PULL
-        re-bases ``G_base[w] = G``."""
+        re-bases ``G_base[w] = G``.
+
+    ``liveness_timeout_s``: a worker not heard from (any message on any of
+    its connections counts) for this long is evicted into
+    ``failed_workers`` — survivors' gates exclude it. ``None`` reads the
+    global FaultConfig; ``<= 0`` disables the monitor (reference
+    semantics: a hung peer wedges every gate forever). Abrupt disconnect
+    of a worker's LAST live connection evicts immediately, without waiting
+    for the timeout. Any later activity from the worker rejoins it."""
 
     def __init__(self, params: Dict, n_workers: int,
                  host: str = "127.0.0.1", port: int = 0,
-                 server_logic: str = "inc", init_step: float = 0.1):
+                 server_logic: str = "inc", init_step: float = 0.1,
+                 liveness_timeout_s: Optional[float] = None):
         if server_logic not in ("inc", "adarevision"):
             raise ValueError(f"unknown server_logic {server_logic!r}")
         self.anchor = _tree_copy(params)
@@ -152,11 +246,28 @@ class ParamService:
         self.max_spread = 0
         self.done_workers: set = set()
         # elasticity (beyond the reference's fail-fast, comm_bus.hpp:22-24):
-        # a worker whose connection dies WITHOUT a clean bye/done is marked
-        # failed; surviving workers' gates then exclude it instead of
-        # timing out, and its already-applied clocks stay in the anchor
+        # a worker whose LAST connection dies WITHOUT a clean bye/done — or
+        # that goes silent past the liveness timeout — is evicted into
+        # failed_workers; surviving workers' gates then exclude it instead
+        # of timing out, and its already-applied clocks stay in the anchor
         # (bounded update loss = its un-flushed oplog, the PS failure model)
         self.failed_workers: set = set()
+        # exactly-once PUSH: per-worker applied-sequence high-water mark; a
+        # reconnecting client replays un-acked flushes and duplicates
+        # (same seq) are acked without a second apply
+        self.applied_seq = {w: -1 for w in range(n_workers)}
+        if liveness_timeout_s is None:
+            from .. import config as _config
+            liveness_timeout_s = _config.fault_config().liveness_timeout_s
+        self.liveness_timeout_s = liveness_timeout_s or 0.0
+        now = time.time()
+        # grace window: a worker that never connects still gets evicted,
+        # one liveness timeout after service start
+        self.last_seen = {w: now for w in range(n_workers)}
+        self._conn_counts: Dict[int, int] = {}  # live identified conns
+        self.evictions = 0   # liveness-timeout evictions (telemetry)
+        self.rejoins = 0     # un-evictions via later activity (telemetry)
+        self.bad_frames = 0  # malformed/truncated frames dropped (telemetry)
         self._srv = socket.create_server((host, port))
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
@@ -164,6 +275,10 @@ class ParamService:
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        if self.liveness_timeout_s > 0:
+            m = threading.Thread(target=self._monitor_loop, daemon=True)
+            m.start()
+            self._threads.append(m)
 
     # ---- server loop ---------------------------------------------------- #
     def _accept_loop(self) -> None:
@@ -175,85 +290,173 @@ class ParamService:
                 continue
             except OSError:
                 return
-            t = threading.Thread(target=self._serve, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            # per-connection threads are daemonic and never joined; do NOT
+            # retain them — reconnect/heartbeat churn over a long run would
+            # grow the list without bound on the service host
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _monitor_loop(self) -> None:
+        """Evict workers silent past the liveness timeout. Detection is
+        bounded by timeout + poll period; done workers are exempt (they
+        closed cleanly), failed ones already evicted."""
+        period = max(0.02, min(0.25, self.liveness_timeout_s / 4.0))
+        while not self._stop.wait(period):
+            now = time.time()
+            with self._lock:
+                for w in range(self.n_workers):
+                    if w in self.failed_workers or w in self.done_workers:
+                        continue
+                    silent = now - self.last_seen.get(w, now)
+                    if silent > self.liveness_timeout_s:
+                        self.failed_workers.add(w)
+                        self.evictions += 1
+                        _log(f"ParamService: evicting worker {w} "
+                             f"(silent {silent:.1f}s > liveness "
+                             f"{self.liveness_timeout_s:.1f}s); survivors' "
+                             f"gates now exclude it")
+
+    def _touch(self, worker: int) -> None:
+        """Record liveness; any activity from an evicted worker rejoins it
+        (its clock resumes where the anchor last applied it)."""
+        with self._lock:
+            self.last_seen[worker] = time.time()
+            if worker in self.failed_workers:
+                self.failed_workers.discard(worker)
+                self.rejoins += 1
+                _log(f"ParamService: worker {worker} rejoined "
+                     f"(clock {self.clocks.get(worker, -1)})")
 
     def _serve(self, conn: socket.socket) -> None:
         worker: Optional[int] = None
+        registered = False
         abnormal = False
         try:
             while not self._stop.is_set():
-                msg = _recv_msg(conn)
-                kind = msg["kind"]
-                if "worker" in msg:
-                    worker = msg["worker"]
-                if kind == "hello":
-                    _send_msg(conn, {"ok": True})
-                elif kind == "push":
+                try:
+                    msg = _recv_msg(conn)
+                except FrameError as e:
+                    # a corrupt peer must never take the service down: log,
+                    # drop THIS connection, keep serving everyone else (the
+                    # client's replay-on-reconnect makes the drop lossless)
+                    abnormal = True
                     with self._lock:
-                        if self.server_logic == "adarevision":
-                            self._apply_adarevision(msg["worker"],
-                                                    msg["delta"])
-                        else:
-                            _tree_add(self.anchor, msg["delta"])
-                        self.clocks[msg["worker"]] = msg["clock"]
-                        self._version += 1
-                        cs = [c for w, c in self.clocks.items()
-                              if w not in self.failed_workers]
-                        if cs and all(c >= 0 for c in cs):
-                            self.max_spread = max(self.max_spread,
-                                                  max(cs) - min(cs))
-                    _send_msg(conn, {"ok": True,
-                                     "clocks": dict(self.clocks),
-                                     "failed":
-                                         sorted(self.failed_workers)})
-                elif kind == "pull":
-                    # copy under the lock, serialize/send OUTSIDE it — a
-                    # slow client socket must not stall concurrent pushes
-                    # (that would be a barrier through the back door)
-                    with self._lock:
-                        snap = _tree_copy(self.anchor)
-                        clocks = dict(self.clocks)
-                        done = sorted(self.done_workers)
-                        failed = sorted(self.failed_workers)
-                        version = self._version
-                        if self.server_logic == "adarevision" and \
-                                worker is not None:
-                            # the read re-bases this worker's backlog: its
-                            # next gradients build on THIS snapshot
-                            self.gbase[worker] = _tree_copy(self.gsum)
-                    _send_msg(conn, {"anchor": snap, "clocks": clocks,
-                                     "done": done, "failed": failed,
-                                     "version": version})
-                elif kind == "clocks":
-                    with self._lock:
-                        clocks = dict(self.clocks)
-                        failed = sorted(self.failed_workers)
-                    _send_msg(conn, {"clocks": clocks, "failed": failed})
-                elif kind == "done":
-                    # a worker finished its run (NOT a barrier: stragglers
-                    # keep training; the driver polls done_count to decide
-                    # when the anchor is final)
-                    with self._lock:
-                        self.done_workers.add(msg["worker"])
-                    _send_msg(conn, {"ok": True})
-                elif kind == "bye":
-                    _send_msg(conn, {"ok": True})
-                    worker = None        # clean shutdown, never "failed"
+                        self.bad_frames += 1
+                    _log(f"ParamService: dropping connection "
+                         f"(worker={worker}): {e}")
                     return
-        except (ConnectionError, EOFError, OSError):
-            abnormal = True
-            return
+                except (ConnectionError, EOFError, OSError):
+                    abnormal = True
+                    return
+                try:
+                    kind = msg["kind"]
+                    if "worker" in msg and worker is None:
+                        worker = msg["worker"]
+                        with self._lock:
+                            self._conn_counts[worker] = \
+                                self._conn_counts.get(worker, 0) + 1
+                        registered = True
+                    if worker is not None:
+                        self._touch(worker)
+                    if kind == "hello":
+                        # identification + liveness only; a restarted
+                        # worker resumes its clock/seq via rejoin()'s pull
+                        _send_msg(conn, {"ok": True})
+                    elif kind == "push":
+                        w = msg["worker"]
+                        seq = msg.get("seq", msg["clock"])
+                        with self._lock:
+                            dup = seq <= self.applied_seq.get(w, -1)
+                            if not dup:
+                                if self.server_logic == "adarevision":
+                                    self._apply_adarevision(w, msg["delta"])
+                                else:
+                                    _tree_add(self.anchor, msg["delta"])
+                                self.applied_seq[w] = seq
+                                self.clocks[w] = max(
+                                    self.clocks.get(w, -1), msg["clock"])
+                                self._version += 1
+                                cs = [c for ww, c in self.clocks.items()
+                                      if ww not in self.failed_workers]
+                                if cs and all(c >= 0 for c in cs):
+                                    self.max_spread = max(
+                                        self.max_spread, max(cs) - min(cs))
+                            ack = {"ok": True, "dup": dup,
+                                   "clocks": dict(self.clocks),
+                                   "failed": sorted(self.failed_workers)}
+                        _send_msg(conn, ack)
+                    elif kind == "heartbeat":
+                        # liveness already recorded by _touch above; the
+                        # reply piggybacks the clock vector so idle workers
+                        # see evictions/progress without an extra RPC
+                        with self._lock:
+                            clocks = dict(self.clocks)
+                            failed = sorted(self.failed_workers)
+                        _send_msg(conn, {"ok": True, "clocks": clocks,
+                                         "failed": failed})
+                    elif kind == "pull":
+                        # copy under the lock, serialize/send OUTSIDE it —
+                        # a slow client socket must not stall concurrent
+                        # pushes (that would be a barrier through the back
+                        # door)
+                        with self._lock:
+                            snap = _tree_copy(self.anchor)
+                            clocks = dict(self.clocks)
+                            done = sorted(self.done_workers)
+                            failed = sorted(self.failed_workers)
+                            version = self._version
+                            if self.server_logic == "adarevision" and \
+                                    worker is not None:
+                                # the read re-bases this worker's backlog:
+                                # its next gradients build on THIS snapshot
+                                self.gbase[worker] = _tree_copy(self.gsum)
+                        _send_msg(conn, {"anchor": snap, "clocks": clocks,
+                                         "done": done, "failed": failed,
+                                         "version": version})
+                    elif kind == "clocks":
+                        with self._lock:
+                            clocks = dict(self.clocks)
+                            failed = sorted(self.failed_workers)
+                        _send_msg(conn, {"clocks": clocks, "failed": failed})
+                    elif kind == "done":
+                        # a worker finished its run (NOT a barrier:
+                        # stragglers keep training; the driver polls
+                        # done_count to decide when the anchor is final)
+                        with self._lock:
+                            self.done_workers.add(msg["worker"])
+                        _send_msg(conn, {"ok": True})
+                    elif kind == "bye":
+                        _send_msg(conn, {"ok": True})
+                        abnormal = False   # clean shutdown, never "failed"
+                        return
+                    else:
+                        raise ValueError(f"unknown message kind {kind!r}")
+                except (ConnectionError, OSError):
+                    abnormal = True
+                    return
+                except Exception as e:  # noqa: BLE001 — bad request shape
+                    # unknown kind / missing field / wrong types: same
+                    # containment as a malformed frame — the per-connection
+                    # thread must die loudly-logged, the service must not
+                    abnormal = True
+                    with self._lock:
+                        self.bad_frames += 1
+                    _log(f"ParamService: bad request (worker={worker}): "
+                         f"{type(e).__name__}: {e}")
+                    return
         finally:
-            # ONLY an abnormal disconnect marks failure: a server-side
-            # shutdown (_stop) exiting the loop must not condemn a live
-            # worker mid-interaction
-            if abnormal and worker is not None and \
-                    worker not in self.done_workers:
+            # ONLY an abnormal disconnect of the worker's LAST live
+            # connection marks failure: a server-side shutdown (_stop)
+            # exiting the loop must not condemn a live worker, and a
+            # reconnected client's fresh sockets must not be condemned by
+            # the old half-dead ones unwinding late
+            if registered and worker is not None:
                 with self._lock:
-                    self.failed_workers.add(worker)
+                    self._conn_counts[worker] -= 1
+                    if abnormal and worker not in self.done_workers and \
+                            self._conn_counts[worker] <= 0 and \
+                            worker not in self.failed_workers:
+                        self.failed_workers.add(worker)
             conn.close()
 
     def _apply_adarevision(self, worker: int, u: Dict) -> None:
@@ -288,32 +491,44 @@ class AsyncSSPClient:
 
     The training thread calls :meth:`push` (enqueue, returns immediately),
     :meth:`gate` (blocks only on a staleness violation), and
-    :meth:`refresh` (pull + rebuild the read-my-writes cache)."""
+    :meth:`refresh` (pull + rebuild the read-my-writes cache).
+
+    Both channels self-heal: a broken socket is redialed with capped
+    exponential backoff + full jitter for up to ``reconnect_deadline_s``;
+    the push channel replays every un-acked flush on reconnect (the
+    service's per-worker sequence dedup makes the replay exactly-once).
+    Only when the deadline is exhausted does the failure surface — as a
+    RuntimeError from the next ``push``/``gate``/``refresh`` — so the
+    training loop always learns about a permanently dead tier instead of
+    silently losing oplogs behind a dead sender thread."""
 
     def __init__(self, worker: int, addr: Tuple[str, int],
                  staleness: int, n_workers: int = 0,
                  retry_s: float = 10.0, server_logic: str = "inc",
-                 init_step: float = 0.1):
+                 init_step: float = 0.1,
+                 heartbeat_s: Optional[float] = None,
+                 reconnect_deadline_s: Optional[float] = None,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_cap_s: Optional[float] = None):
         self.worker = worker
         self.n_workers = n_workers if n_workers else worker + 1
         self.staleness = staleness
         self.server_logic = server_logic
         self.init_step = init_step
-        deadline = time.time() + retry_s
-        while True:
-            try:
-                self._push_sock = socket.create_connection(addr)
-                self._pull_sock = socket.create_connection(addr)
-                break
-            except OSError:
-                if time.time() > deadline:
-                    raise
-                time.sleep(0.05)
-        # identify BOTH sockets up front: failure detection attributes an
-        # abrupt disconnect to this worker even if it never pushed
-        for sk in (self._push_sock, self._pull_sock):
-            _send_msg(sk, {"kind": "hello", "worker": worker})
-            _recv_msg(sk)
+        self._addr = addr
+        (self.heartbeat_s, _, self.reconnect_deadline_s,
+         self.backoff_base_s, self.backoff_cap_s) = _fault_defaults(
+            heartbeat_s, None, reconnect_deadline_s,
+            backoff_base_s, backoff_cap_s)
+        # deterministic per-worker jitter stream (tests; and distinct
+        # workers de-synchronize their retries by construction)
+        self._rng = random.Random(0xA5 ^ worker)
+        self._stop = threading.Event()
+        self.reconnects = 0
+        # initial connect: the service may come up AFTER the workers under
+        # a real launcher — retry_s is the rendezvous deadline
+        self._push_sock = self._dial(retry_s)
+        self._pull_sock = self._dial(retry_s)
         self._push_lock = threading.Lock()
         self._pull_lock = threading.Lock()
         self._q: "queue.Queue" = queue.Queue()
@@ -326,38 +541,172 @@ class AsyncSSPClient:
         self.blocked_s = 0.0     # cumulative gate wait (telemetry)
         self.gate_blocks = 0
         self.dead: Optional[BaseException] = None
-        self._stop = threading.Event()
         self._sender = threading.Thread(target=self._send_loop, daemon=True)
         self._sender.start()
 
+    # ---- channel (re)establishment -------------------------------------- #
+    def _dial_once(self) -> socket.socket:
+        """One connect + identify attempt. Identifying EVERY socket up
+        front matters twice over: failure detection attributes an abrupt
+        disconnect to this worker even if it never pushed, and any hello
+        from an evicted worker is its rejoin signal."""
+        sk = socket.create_connection(self._addr, timeout=5.0)
+        try:
+            _send_msg(sk, {"kind": "hello", "worker": self.worker})
+            _recv_msg(sk)
+        except BaseException:
+            sk.close()
+            raise
+        # established: the channel must BLOCK from here on — leaving the
+        # 5 s dial timeout on the long-lived socket would misread a
+        # slow-but-alive service (big anchor copy, lock contention) as a
+        # dead channel and churn reconnects (slow != dead)
+        sk.settimeout(None)
+        return sk
+
+    def _dial(self, deadline: float) -> socket.socket:
+        from ..runtime.retry import retry_with_backoff
+        return retry_with_backoff(
+            self._dial_once, deadline=deadline, base=self.backoff_base_s,
+            cap=self.backoff_cap_s, rng=self._rng,
+            retry_on=(OSError, EOFError), should_stop=self._stop.is_set)
+
+    def _reconnect_channel(self, lock: threading.Lock, sock_attr: str,
+                           body: Callable[[socket.socket], Dict]) -> Dict:
+        """Shared recovery envelope for both channels: redial with the
+        backoff policy, run ``body`` on the fresh socket, and only then
+        install it as ``sock_attr`` (closing the dead one) — a socket that
+        failed mid-``body`` is discarded, never installed half-used."""
+        from ..runtime.retry import retry_with_backoff
+
+        def attempt() -> Dict:
+            sk = self._dial_once()
+            try:
+                out = body(sk)
+            except BaseException:
+                sk.close()
+                raise
+            with lock:
+                old = getattr(self, sock_attr)
+                setattr(self, sock_attr, sk)
+            try:
+                old.close()
+            except OSError:
+                pass
+            return out
+
+        out = retry_with_backoff(
+            attempt, deadline=self.reconnect_deadline_s,
+            base=self.backoff_base_s, cap=self.backoff_cap_s,
+            rng=self._rng, retry_on=(OSError, EOFError),
+            should_stop=self._stop.is_set)
+        self.reconnects += 1
+        return out
+
+    def _recover_push(self, msg: Optional[Dict]) -> Dict:
+        """Reconnect the push channel and replay every un-acked flush in
+        clock order (the service dedups by seq, so a flush whose ack was
+        lost in the crash is applied exactly once). ``msg`` is the RPC
+        that hit the dead socket: a push is already in the pending oplog
+        and rides the replay; anything else is re-sent afterwards."""
+        def replay(sk: socket.socket) -> Dict:
+            with self._pending_lock:
+                backlog = [(c, d) for c, d in self._pending
+                           if c > self._acked_clock]
+            ack: Optional[Dict] = None
+            for c, d in backlog:
+                _send_msg(sk, {"kind": "push", "worker": self.worker,
+                               "clock": c, "seq": c, "delta": d})
+                ack = _recv_msg(sk)
+                self._acked_clock = max(self._acked_clock, c)
+            if msg is not None and msg.get("kind") != "push":
+                _send_msg(sk, msg)
+                ack = _recv_msg(sk)
+            return ack if ack is not None else {"ok": True}
+
+        ack = self._reconnect_channel(self._push_lock, "_push_sock", replay)
+        _log(f"async-SSP worker {self.worker}: push channel reconnected "
+             f"(replayed through clock {self._acked_clock})")
+        return ack
+
+    def _push_rpc(self, msg: Dict) -> Dict:
+        """One RPC on the push channel (sender thread only), recovering a
+        dead socket by reconnect + replay."""
+        try:
+            with self._push_lock:
+                _send_msg(self._push_sock, msg)
+                ack = _recv_msg(self._push_sock)
+        except (OSError, EOFError) as e:
+            if self._stop.is_set():
+                raise
+            _log(f"async-SSP worker {self.worker}: push channel lost "
+                 f"({type(e).__name__}: {e}); reconnecting")
+            ack = self._recover_push(msg)
+        if isinstance(ack, dict) and "clocks" in ack:
+            self.clocks = ack["clocks"]
+            self.failed = set(ack.get("failed", ()))
+        return ack
+
+    def _pull_rpc(self, msg: Dict) -> Dict:
+        """One RPC on the pull channel (training thread only), recovering a
+        dead socket by reconnect + retry. Every pull-channel request is
+        idempotent (pull/clocks/done), so a blind retry is safe."""
+        try:
+            with self._pull_lock:
+                _send_msg(self._pull_sock, msg)
+                return _recv_msg(self._pull_sock)
+        except (OSError, EOFError) as e:
+            if self._stop.is_set():
+                raise
+            _log(f"async-SSP worker {self.worker}: pull channel lost "
+                 f"({type(e).__name__}: {e}); reconnecting")
+
+        def resend(sk: socket.socket) -> Dict:
+            _send_msg(sk, msg)
+            return _recv_msg(sk)
+
+        return self._reconnect_channel(self._pull_lock, "_pull_sock", resend)
+
     # ---- non-blocking dispatch ------------------------------------------ #
     def _send_loop(self) -> None:
+        last_hb = time.time()
+        poll = min(0.25, max(0.02, (self.heartbeat_s or 1.0) / 4.0))
         while not self._stop.is_set():
             try:
-                clock, delta = self._q.get(timeout=0.25)
+                item = self._q.get(timeout=poll)
             except queue.Empty:
-                continue
+                item = None
             try:
-                with self._push_lock:
-                    _send_msg(self._push_sock,
-                              {"kind": "push", "worker": self.worker,
-                               "clock": clock, "delta": delta})
-                    ack = _recv_msg(self._push_sock)
-                self.clocks = ack["clocks"]
-                self.failed = set(ack.get("failed", ()))
-                self._acked_clock = clock
+                if item is not None:
+                    clock, delta = item
+                    if clock > self._acked_clock:
+                        # (a recovery replay may already have landed it)
+                        self._push_rpc({"kind": "push",
+                                        "worker": self.worker,
+                                        "clock": clock, "seq": clock,
+                                        "delta": delta})
+                        self._acked_clock = max(self._acked_clock, clock)
+                    last_hb = time.time()
+                elif self.heartbeat_s > 0 and \
+                        time.time() - last_hb >= self.heartbeat_s:
+                    # idle: heartbeat so the service's liveness monitor
+                    # never mistakes a slow-but-alive worker for a dead one
+                    self._push_rpc({"kind": "heartbeat",
+                                    "worker": self.worker})
+                    last_hb = time.time()
             except BaseException as e:  # noqa: BLE001 — surface, never lose
-                # a dead sender must FAIL the run, not silently drop oplogs:
-                # push()/gate()/drain all re-raise this
+                # reconnect deadline exhausted: FAIL the run, not silently
+                # drop oplogs — push()/gate()/drain all re-raise this
                 self.dead = e
                 return
 
     def _check_alive(self) -> None:
         if self.dead is not None:
             raise RuntimeError(
-                f"worker {self.worker}: update dispatch died "
-                f"({type(self.dead).__name__}: {self.dead}); oplogs from "
-                f"clock {self._acked_clock + 1} on were never applied"
+                f"worker {self.worker}: update dispatch died after "
+                f"reconnect attempts ({type(self.dead).__name__}: "
+                f"{self.dead}); oplogs from clock "
+                f"{self._acked_clock + 1} on were never applied"
             ) from self.dead
 
     def push(self, delta: Dict) -> int:
@@ -370,13 +719,24 @@ class AsyncSSPClient:
         self._q.put((self.clock, delta))
         return self.clock
 
-    def _drain(self, timeout_s: float = 10.0) -> None:
+    def _drain(self, timeout_s: Optional[float] = None) -> None:
         """Wait until the server ACKED every flushed clock (not merely
         until the queue emptied — the sender may be mid-RPC on the last
-        delta, and 'done'/'bye' must not overtake it)."""
+        delta, and 'done'/'bye' must not overtake it). The default
+        deadline covers a full reconnect-and-replay cycle; expiry RAISES:
+        returning quietly here would let mark_done()/close() declare a run
+        complete while its final flush is still un-acked — exactly the
+        silent update loss this tier exists to rule out."""
+        if timeout_s is None:
+            timeout_s = self.reconnect_deadline_s + 10.0
         deadline = time.time() + timeout_s
-        while self._acked_clock < self.clock and time.time() < deadline:
+        while self._acked_clock < self.clock:
             self._check_alive()
+            if time.time() >= deadline:
+                raise RuntimeError(
+                    f"worker {self.worker}: drain timed out with clocks "
+                    f"{self._acked_clock + 1}..{self.clock} still un-acked "
+                    f"after {timeout_s:.1f}s")
             time.sleep(0.005)
 
     # ---- the SSP read gate ---------------------------------------------- #
@@ -395,7 +755,11 @@ class AsyncSSPClient:
         """Block until every OTHER worker's applied clock is >= clock - s - 1
         (ssp_consistency_controller.cpp:37-77: a read at clock c must see
         all updates through c - s - 1). Within the window this returns
-        immediately — the wait-free property."""
+        immediately — the wait-free property. A peer that dies mid-wait is
+        evicted by the service (disconnect detection or liveness timeout)
+        and leaves the gate's clock vector, so survivors unblock within
+        the liveness timeout instead of hanging to this call's own
+        backstop ``timeout_s``."""
         self._check_alive()
         need = clock - self.staleness - 1
         if self._min_other_clock() >= need:
@@ -403,13 +767,13 @@ class AsyncSSPClient:
         t0 = time.time()
         self.gate_blocks += 1
         while self._min_other_clock() < need:
+            self._check_alive()
             if time.time() - t0 > timeout_s:
                 raise TimeoutError(
                     f"worker {self.worker} stuck at gate: need clock {need}, "
-                    f"have {self.clocks} (a peer died?)")
-            with self._pull_lock:
-                _send_msg(self._pull_sock, {"kind": "clocks"})
-                resp = _recv_msg(self._pull_sock)
+                    f"have {self.clocks} (a peer died and eviction is "
+                    f"disabled?)")
+            resp = self._pull_rpc({"kind": "clocks"})
             self.clocks = resp["clocks"]
             self.failed = set(resp.get("failed", ()))
             time.sleep(poll_s)
@@ -427,11 +791,10 @@ class AsyncSSPClient:
         only correct once every earlier push has been applied — and the
         pending rebuild scales raw gradients by -init_step (the client-lr
         preview), never adds them raw."""
+        self._check_alive()
         if self.server_logic == "adarevision":
             self._drain()
-        with self._pull_lock:
-            _send_msg(self._pull_sock, {"kind": "pull"})
-            snap = _recv_msg(self._pull_sock)
+        snap = self._pull_rpc({"kind": "pull"})
         self.clocks = snap["clocks"]
         self.failed = set(snap.get("failed", ()))
         applied = self.clocks.get(self.worker, -1)
@@ -443,7 +806,7 @@ class AsyncSSPClient:
                     # pending entries are RAW gradients: preview them at
                     # the client-lr estimate, exactly as the worker loop
                     # advanced its cache (normally empty here — the drain
-                    # above leaves pendings only after its timeout)
+                    # above acked everything, or raised)
                     for l, ps in d.items():
                         for pn, gv in ps.items():
                             cache[l][pn] = cache[l][pn] - \
@@ -452,15 +815,31 @@ class AsyncSSPClient:
                     _tree_add(cache, d)
         return cache, dict(self.clocks)
 
+    def rejoin(self) -> Tuple[Dict, Dict[int, int]]:
+        """Rejoin protocol for a RESTARTED worker process: pull the
+        anchor, re-seed the local cache from it, and resume at the
+        anchor's recorded clock for this worker. Everything the anchor
+        applied before the crash is in the snapshot; everything after is
+        the bounded update loss of the failure model. The hello this
+        client sent at connect already un-evicted the worker server-side.
+        Clears the (empty, for a fresh process) local oplog and returns
+        (cache, clock_vector); training resumes at ``self.clock + 1``."""
+        snap = self._pull_rpc({"kind": "pull"})
+        self.clocks = snap["clocks"]
+        self.failed = set(snap.get("failed", ()))
+        applied = self.clocks.get(self.worker, -1)
+        self.clock = applied
+        self._acked_clock = applied
+        with self._pending_lock:
+            self._pending = []
+        return snap["anchor"], dict(self.clocks)
+
     def mark_done(self) -> None:
         """Tell the service this worker's run is complete (not a barrier)."""
         # every flushed clock must be ACKED first: 'done' must not overtake
         # the final delta still in flight on the push socket
         self._drain()
-        with self._pull_lock:
-            _send_msg(self._pull_sock, {"kind": "done",
-                                        "worker": self.worker})
-            _recv_msg(self._pull_sock)
+        self._pull_rpc({"kind": "done", "worker": self.worker})
 
     def wait_all_done(self, n_workers: int,
                       timeout_s: float = 300.0) -> Tuple[set, set]:
@@ -470,9 +849,7 @@ class AsyncSSPClient:
         never keep a partial result quiet."""
         t0 = time.time()
         while True:
-            with self._pull_lock:
-                _send_msg(self._pull_sock, {"kind": "pull"})
-                snap = _recv_msg(self._pull_sock)
+            snap = self._pull_rpc({"kind": "pull"})
             done = set(snap.get("done", ()))
             failed = set(snap.get("failed", ()))
             if len(done | failed) >= n_workers:
@@ -518,6 +895,8 @@ def run_async_ssp_worker(
     slow_s: float = 0.0,
     server_logic: str = "inc",
     init_step: float = 0.1,
+    rejoin: bool = False,
+    client_opts: Optional[Dict] = None,
 ) -> Dict:
     """Drive one worker through ``n_clocks`` flush clocks.
 
@@ -532,6 +911,12 @@ def run_async_ssp_worker(
     lr estimate the reference's process storage uses between refreshes;
     every refresh replaces it with the server's revised view.
 
+    ``rejoin=True`` is the restart path: seed the cache from the service
+    anchor and resume at the anchor's recorded clock for this worker
+    (``params`` is then only a shape/typing fallback). ``client_opts``
+    forwards fault-tolerance knobs (heartbeat_s, reconnect_deadline_s,
+    backoff_*) to :class:`AsyncSSPClient`.
+
     This driver owns only the DCN-tier exchange: gate -> step(s) -> push ->
     refresh. ``slow_s`` injects per-clock straggler delay (test harness).
     Returns the final cache + telemetry."""
@@ -540,13 +925,19 @@ def run_async_ssp_worker(
     else:
         addr = service_addr
     cli = AsyncSSPClient(worker, addr, staleness, n_workers=n_workers,
-                         server_logic=server_logic, init_step=init_step)
+                         server_logic=server_logic, init_step=init_step,
+                         **(client_opts or {}))
     adarev = server_logic == "adarevision"
-    cache = _tree_copy(params)
     losses = []
+    start_clock = 0
+    if rejoin:
+        cache, _ = cli.rejoin()
+        start_clock = cli.clock + 1
+    else:
+        cache = _tree_copy(params)
     t_start = time.time()
     try:
-        for clock in range(n_clocks):
+        for clock in range(start_clock, n_clocks):
             cli.gate(clock)
             if slow_s:
                 time.sleep(slow_s)
@@ -576,6 +967,7 @@ def run_async_ssp_worker(
         cli.mark_done()
         return {"params": cache, "losses": losses,
                 "blocked_s": cli.blocked_s, "gate_blocks": cli.gate_blocks,
-                "wall_s": wall, "final_clock": cli.clock}
+                "wall_s": wall, "final_clock": cli.clock,
+                "reconnects": cli.reconnects, "start_clock": start_clock}
     finally:
         cli.close()

@@ -1,5 +1,32 @@
-from .engine import Engine, resolve_nets  # noqa: F401
-from .metrics import MetricsTable, StatsRegistry, log  # noqa: F401
-from .checkpoint import (  # noqa: F401
-    latest_snapshot, load_caffemodel, restore, snapshot,
-)
+"""Runtime package: engine, metrics, checkpointing, cluster control plane.
+
+Re-exports resolve lazily (PEP 562): importing a light submodule
+(``runtime.retry``, ``runtime.metrics``, ``runtime.faults``) from a
+plain-socket worker process must not drag in ``engine`` — and with it jax —
+as an eager ``from .engine import Engine`` here would.
+"""
+
+_LAZY = {
+    "Engine": ("engine", "Engine"),
+    "resolve_nets": ("engine", "resolve_nets"),
+    "MetricsTable": ("metrics", "MetricsTable"),
+    "StatsRegistry": ("metrics", "StatsRegistry"),
+    "log": ("metrics", "log"),
+    "latest_snapshot": ("ckpt_files", "latest_snapshot"),
+    "sweep_stale_tmp": ("ckpt_files", "sweep_stale_tmp"),
+    "load_caffemodel": ("checkpoint", "load_caffemodel"),
+    "restore": ("checkpoint", "restore"),
+    "snapshot": ("checkpoint", "snapshot"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    from importlib import import_module
+    return getattr(import_module(f".{mod_name}", __name__), attr)

@@ -45,7 +45,10 @@ class AsyncSSPTier:
     """Owns the service (rank 0), the client, and the flush cadence."""
 
     def __init__(self, params: Dict, staleness: int, sync_every: int = 1,
-                 service_port: Optional[int] = None):
+                 service_port: Optional[int] = None,
+                 heartbeat_s: Optional[float] = None,
+                 liveness_timeout_s: Optional[float] = None,
+                 reconnect_deadline_s: Optional[float] = None):
         self.rank, self.n_procs, coord = env_world()
         self.staleness = staleness
         self.sync_every = max(1, sync_every)
@@ -59,13 +62,34 @@ class AsyncSSPTier:
         if port is None:
             port = 12356
         self.service = None
-        host0 = _to_host(params)
         if self.rank == 0:
-            self.service = ParamService(host0, n_workers=self.n_procs,
-                                        host=host, port=port)
-        self.client = AsyncSSPClient(self.rank, (host, port), staleness,
-                                     n_workers=self.n_procs)
-        self._prev = host0
+            # only the service seed needs the host copy of params — every
+            # rank's own view (_prev/resume_cache) comes from rejoin()'s
+            # anchor pull below. None knobs resolve to the global
+            # FaultConfig inside the service/client (config.fault_config())
+            self.service = ParamService(
+                _to_host(params), n_workers=self.n_procs, host=host,
+                port=port, liveness_timeout_s=liveness_timeout_s)
+        self.client = AsyncSSPClient(
+            self.rank, (host, port), staleness, n_workers=self.n_procs,
+            heartbeat_s=heartbeat_s,
+            reconnect_deadline_s=reconnect_deadline_s)
+        # restart-aware join: if the service already holds an applied clock
+        # for this worker (a previous incarnation pushed before dying), the
+        # push-seq stream MUST resume past it — a fresh client restarting
+        # at seq 0 would have every post-restart flush swallowed by the
+        # exactly-once dedup. rejoin() also hands back the anchor, which
+        # seeds the cache for restarted AND fresh workers alike (everyone
+        # starts from the same rank-0 view, the reference's init
+        # broadcast); Engine.train adopts it via ``resume_cache``.
+        cache, clocks = self.client.rejoin()
+        applied = clocks.get(self.rank, -1)
+        if applied >= 0:
+            log(f"async-SSP tier: rank {self.rank} rejoined at clock "
+                f"{applied}; push stream resumes at {applied + 1}",
+                rank=self.rank)
+        self._prev = cache
+        self.resume_cache = cache
         self._iters_since = 0
         self._t0 = time.time()
         log(f"async-SSP tier: {self.n_procs} workers, staleness "
@@ -100,11 +124,14 @@ class AsyncSSPTier:
         self.client.mark_done()
         out = {"async_blocked_s": round(self.client.blocked_s, 3),
                "async_gate_blocks": float(self.client.gate_blocks),
-               "async_final_clock": float(self.client.clock)}
+               "async_final_clock": float(self.client.clock),
+               "async_reconnects": float(self.client.reconnects)}
         if self.service is not None:
             # poll (not barrier) until the stragglers flush their last clock
             done, failed = self.client.wait_all_done(self.n_procs)
             out["async_max_spread"] = float(self.service.max_spread)
+            out["async_evictions"] = float(self.service.evictions)
+            out["async_rejoins"] = float(self.service.rejoins)
             if failed:
                 # elasticity keeps the job alive; it must never keep the
                 # loss quiet — the failed workers' un-flushed updates are

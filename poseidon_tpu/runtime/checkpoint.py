@@ -14,7 +14,7 @@ binary NetParameter (``.caffemodel``, written by rank 0) and per-worker
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +25,7 @@ from ..parallel.trainer import (SSPState, TrainState, init_comm_error,
                                 init_ssp_state, reconcile_comm_error)
 from ..proto.wire import decode_caffemodel, encode_caffemodel
 from ..solvers.updates import SolverState
+from .ckpt_files import latest_snapshot, sweep_stale_tmp  # noqa: F401
 
 
 # Layer names may contain '/' (GoogLeNet's "inception_3a/1x1"), so tree keys
@@ -219,19 +220,6 @@ def load_caffemodel(path: str, net: Net, params):
     return net.load_weights(params, weights)
 
 
-def latest_snapshot(prefix: str,
-                    suffix: str = ".solverstate.npz") -> Optional[str]:
-    d = os.path.dirname(prefix) or "."
-    base = os.path.basename(prefix)
-    best, best_it = None, -1
-    if not os.path.isdir(d):
-        return None
-    for name in os.listdir(d):
-        if name.startswith(base + "_iter_") and name.endswith(suffix):
-            try:
-                it = int(name[len(base + "_iter_"):-len(suffix)])
-            except ValueError:
-                continue
-            if it > best_it:
-                best, best_it = os.path.join(d, name), it
-    return best
+# latest_snapshot / sweep_stale_tmp live in ckpt_files (re-exported above):
+# pure-filesystem discovery and tmp hygiene, kept jax-free for the socket
+# tier.

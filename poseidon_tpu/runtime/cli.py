@@ -73,6 +73,16 @@ def _engine_from_args(args, phase_nets=True):
         # stays plain sync SGD on this process's own mesh
         async_cfg = {"staleness": staleness,
                      "sync_every": getattr(args, "async_sync_every", 1)}
+        # fault-tolerance knobs: negative flag values mean "use the
+        # FaultConfig defaults" (config.py) — only explicit settings ride
+        for key, flag in (("heartbeat_s", "async_heartbeat_s"),
+                          ("liveness_timeout_s",
+                           "async_liveness_timeout_s"),
+                          ("reconnect_deadline_s",
+                           "async_reconnect_deadline_s")):
+            v = getattr(args, flag, -1.0)
+            if v is not None and v >= 0:
+                async_cfg[key] = v
         staleness = 0
     return Engine(sp, comm=comm, mesh=mesh, output_dir=args.output_dir,
                   staleness=staleness, sfb_auto=args.sfb_auto,
@@ -106,23 +116,17 @@ def cmd_train(args) -> int:
                          node_id=args.node_id if args.node_id >= 0 else None)
     eng = _engine_from_args(args)
     eng.profile_steps = args.profile
-    snapshot = args.snapshot
-    if snapshot == "auto":
-        # resume from the newest solverstate under the solver's snapshot
-        # prefix — restart-after-preemption without tracking filenames
-        import os
-        from .checkpoint import latest_snapshot
-        prefix = os.path.join(args.output_dir, eng.sp.snapshot_prefix)
-        snapshot = (latest_snapshot(prefix)
-                    if eng.sp.snapshot_prefix else None) or ""
-        if not snapshot:
-            from .metrics import log
-            log(f"--snapshot auto: no snapshot under {prefix!r}; "
-                f"starting fresh", rank=eng.rank)
-    if snapshot:
-        eng.restore_from(snapshot)
+    if args.snapshot == "auto":
+        # engine-level auto-resume: sweep stale snapshot tmp litter a
+        # killed predecessor left behind, then restore the newest
+        # solverstate under the solver's snapshot prefix
+        restored = eng.auto_resume()
+        if restored is None and args.weights:
+            # first run of an auto-resume launch still honors init weights
+            eng.restore_from(args.weights)
+    elif args.snapshot:
+        eng.restore_from(args.snapshot)
     elif args.weights:
-        # first run of an auto-resume launch still honors init weights
         eng.restore_from(args.weights)
     try:
         eng.train()
@@ -483,6 +487,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "jax.distributed world, no cross-process barrier")
     t.add_argument("--async_sync_every", type=int, default=1,
                    help="optimizer iterations per async-SSP flush clock")
+    t.add_argument("--async_heartbeat_s", type=float, default=-1.0,
+                   help="async-SSP client heartbeat cadence (liveness "
+                        "signal when the flush queue is idle); negative = "
+                        "FaultConfig default")
+    t.add_argument("--async_liveness_timeout_s", type=float, default=-1.0,
+                   help="async-SSP service evicts a worker silent this "
+                        "long (survivors' gates unblock; 0 disables — the "
+                        "reference's hang-forever semantics); negative = "
+                        "FaultConfig default")
+    t.add_argument("--async_reconnect_deadline_s", type=float, default=-1.0,
+                   help="async-SSP client gives up reconnecting (and "
+                        "surfaces permanent failure to the training loop) "
+                        "after this long; negative = FaultConfig default")
     t.add_argument("--hostfile", default="",
                    help="cluster hostfile ('<id> <ip> <port>' lines)")
     t.add_argument("--node_id", type=int, default=-1,

@@ -8,15 +8,27 @@ is the JAX distributed coordinator (the name-node role), and the data plane is
 XLA collectives over ICI/DCN compiled into the step — no bg workers, no server
 shards, no oplog wire protocol.
 
-Fail-fast semantics match the reference (comm_bus.hpp:22-24): any rendezvous
-or collective error aborts the process; recovery is via checkpoints.
+Collective errors stay fail-fast like the reference (comm_bus.hpp:22-24);
+recovery is via checkpoints. Rendezvous, however, retries: under a real
+launcher the coordinator process may come up seconds after its peers, and a
+one-shot connect would abort workers that only needed to wait. The retry
+policy is the shared one (runtime/retry.py: capped exponential backoff +
+full jitter, seeded per process id so a whole pod's restarts de-synchronize).
 """
 
 from __future__ import annotations
 
 import os
+import random
 from dataclasses import dataclass
 from typing import List, Optional
+
+from .retry import retry_with_backoff
+
+# rendezvous deadline: how long a process keeps redialing the coordinator
+# before giving up (env-overridable for tests and slow pod bring-up)
+_RENDEZVOUS_DEADLINE_S = float(
+    os.environ.get("POSEIDON_RENDEZVOUS_DEADLINE_S", "60"))
 
 
 @dataclass(frozen=True)
@@ -44,6 +56,51 @@ def parse_hostfile(path: str) -> List[Host]:
     return hosts
 
 
+# jax.distributed.initialize signals both transient handshake failures and
+# permanent misconfiguration as RuntimeError; only messages matching these
+# look like a coordinator that has not come up YET (worth redialing) —
+# anything else ("should only be called once", mismatched world size, ...)
+# must fail fast, and must NOT trigger the shutdown teardown, which would
+# destroy a healthy live client on a double-init call.
+_TRANSIENT_RENDEZVOUS = ("deadline", "unavailable", "connect", "timed out",
+                         "timeout", "refused")
+
+
+def _initialize_with_retry(coordinator_address: str,
+                           num_processes: Optional[int],
+                           process_id: Optional[int]) -> None:
+    """jax.distributed.initialize with the shared backoff policy: keep
+    redialing a not-yet-listening coordinator instead of aborting the
+    worker (the coordinator process routinely starts seconds later under
+    a launcher that brings processes up in any order)."""
+    import jax
+
+    class _Transient(OSError):
+        pass
+
+    def attempt() -> None:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except RuntimeError as e:
+            low = str(e).lower()
+            if not any(s in low for s in _TRANSIENT_RENDEZVOUS):
+                raise  # permanent misconfiguration: fail fast, no teardown
+            # a failed handshake can leave a half-initialized client that
+            # must be torn down before the redial
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            raise _Transient(str(e)) from e
+
+    retry_with_backoff(
+        attempt, deadline=_RENDEZVOUS_DEADLINE_S, base=0.2, cap=5.0,
+        rng=random.Random(process_id if process_id is not None else 0),
+        retry_on=(OSError,))
+
+
 def init_distributed(hostfile: Optional[str] = None,
                      node_id: Optional[int] = None,
                      coordinator_address: Optional[str] = None,
@@ -51,8 +108,6 @@ def init_distributed(hostfile: Optional[str] = None,
     """Initialize the JAX distributed runtime from a hostfile (or explicit
     coordinator config / env). Host 0's entry is the coordinator — the
     name-node analog. Returns this process's id. No-op when single-process."""
-    import jax
-
     if hostfile is not None:
         hosts = parse_hostfile(hostfile)
         if len(hosts) == 1:
@@ -60,14 +115,12 @@ def init_distributed(hostfile: Optional[str] = None,
         if node_id is None:
             raise ValueError("node_id is required with a multi-host hostfile")
         coord = f"{hosts[0].ip}:{hosts[0].port}"
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=len(hosts),
-                                   process_id=node_id)
+        _initialize_with_retry(coord, len(hosts), node_id)
         return node_id
     if coordinator_address is not None:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=node_id)
+        # node_id=None passes through: jax.distributed auto-detects the
+        # process id from the cluster environment
+        _initialize_with_retry(coordinator_address, num_processes, node_id)
         return node_id or 0
     # Env-driven: the scripts/launch.py --local path sets these.
     coord = os.environ.get("POSEIDON_COORDINATOR")
@@ -75,7 +128,6 @@ def init_distributed(hostfile: Optional[str] = None,
         n = int(os.environ["POSEIDON_NUM_PROCS"])
         pid = int(os.environ["POSEIDON_PROC_ID"])
         if n > 1:
-            jax.distributed.initialize(coordinator_address=coord,
-                                       num_processes=n, process_id=pid)
+            _initialize_with_retry(coord, n, pid)
         return pid
     return 0

@@ -37,7 +37,8 @@ from ..parallel import (CommConfig, build_eval_step, build_ssp_train_step,
 from ..parallel.trainer import TrainStep, comm_error_groups, stack_batches
 from ..proto.messages import NetParameter, SolverParameter, load_net
 from ..solvers.updates import learning_rate
-from .checkpoint import load_caffemodel, restore, snapshot
+from .checkpoint import (latest_snapshot, load_caffemodel, restore, snapshot,
+                         sweep_stale_tmp)
 from .metrics import MetricsTable, StatsRegistry, log
 
 
@@ -383,6 +384,31 @@ class Engine:
             log(f"Restored solver state from {path} "
                 f"(iter {self.iteration()})", rank=self.rank)
 
+    def auto_resume(self) -> Optional[str]:
+        """Restart-after-preemption without tracking filenames: sweep any
+        stale snapshot tmp litter a killed predecessor left behind, find
+        the newest ``<prefix>_iter_N.solverstate.npz`` under the solver's
+        snapshot prefix, and restore it. Returns the restored path, or
+        None when there is nothing to resume from (fresh start). Pairs
+        with ``sp.snapshot`` cadence + the async tier's eviction/rejoin:
+        a preempted worker relaunches with the same command line and
+        continues from its last snapshot."""
+        if not self.sp.snapshot_prefix:
+            return None
+        prefix = os.path.join(self.output_dir, self.sp.snapshot_prefix)
+        removed = sweep_stale_tmp(prefix)
+        if removed:
+            log(f"auto-resume: swept {len(removed)} stale snapshot tmp "
+                f"file(s): {', '.join(os.path.basename(r) for r in removed)}",
+                rank=self.rank)
+        path = latest_snapshot(prefix)
+        if path is None:
+            log(f"auto-resume: no snapshot under {prefix!r}; starting fresh",
+                rank=self.rank)
+            return None
+        self.restore_from(path)
+        return path
+
     def snapshot_now(self) -> Optional[str]:
         if not self.sp.snapshot_prefix:
             return None
@@ -468,6 +494,11 @@ class Engine:
         if self._async_cfg is not None and self._async_tier is None:
             from .async_tier import AsyncSSPTier
             self._async_tier = AsyncSSPTier(self.params, **self._async_cfg)
+            # every worker starts from the service anchor: rank 0's view on
+            # a fresh run, the surviving anchor (all applied clocks) when
+            # this process is a preemption restart rejoining mid-job
+            self.params = jax.device_put(self._async_tier.resume_cache,
+                                         self.train_step.replicated)
         # profiler window: skip a couple of warmup/compile steps
         profile_start = it + 2
         profiling = False

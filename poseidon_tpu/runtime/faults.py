@@ -1,0 +1,217 @@
+"""Deterministic fault injection for the host-driven socket tier.
+
+A loopback TCP proxy that sits between an :class:`AsyncSSPClient` (or any
+socket peer) and the upstream service, applying explicit, reproducible
+fault rules per accepted connection — the chaos-test substrate for the
+tier's liveness/eviction/reconnect protocol. Nothing here is random: rules
+match on the accepted-connection index and cut on exact byte counts, so a
+chaos test replays identically run after run (the analog of the
+deterministic 8-virtual-device CPU mesh for the parallel strategies).
+
+Rules (:class:`FaultRule`):
+
+- ``drop``     — accept, then close immediately: the peer's connect()
+                 succeeds but its first read/write sees EOF/RST. Models a
+                 service behind a dead load-balancer slot; exercises the
+                 client's backoff-and-redial loop.
+- ``delay``    — forward both directions, adding ``delay_s`` per chunk.
+                 Models a congested DCN hop; exercises that slow != dead
+                 (heartbeats keep the worker un-evicted).
+- ``truncate`` — forward exactly ``after_bytes`` of client->server
+                 payload, then hard-close both sides. The upstream sees a
+                 mid-message EOF (a torn frame); exercises the service's
+                 FrameError containment + the client's replay.
+- ``sever``    — same cut mechanics as truncate (``after_bytes`` of
+                 client->server traffic, 0 = on first activity), named for
+                 intent: a hard mid-run partition.
+
+Runtime controls: :meth:`FaultProxy.sever_all` hard-drops every live
+connection at once (worker preemption / network partition mid-run);
+:meth:`FaultProxy.refuse_new` black-holes reconnect attempts (the
+partition persists) until lifted.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+__all__ = ["FaultRule", "FaultProxy"]
+
+
+@dataclass
+class FaultRule:
+    """One deterministic fault. ``conn`` matches the nth accepted
+    connection (0-based; None = every connection); ``max_conns`` expires
+    the rule after it has matched that many connections (None = never)."""
+
+    action: str = "sever"          # drop | delay | truncate | sever
+    conn: Optional[int] = None
+    after_bytes: int = 0           # truncate/sever: client->server budget
+    delay_s: float = 0.0           # delay: added latency per chunk
+    max_conns: Optional[int] = None
+    hits: int = field(default=0, repr=False)  # connections matched so far
+
+    def __post_init__(self):
+        if self.action not in ("drop", "delay", "truncate", "sever"):
+            raise ValueError(f"unknown fault action {self.action!r}")
+
+
+class FaultProxy:
+    """Loopback TCP proxy with per-connection fault rules (port 0 bind —
+    no fixed ports, no flakes). ``proxy.addr`` is what the client dials."""
+
+    def __init__(self, upstream: Tuple[str, int], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.upstream = upstream
+        self._rules: List[FaultRule] = []
+        self._lock = threading.Lock()
+        self._pairs: List[Tuple[socket.socket, socket.socket]] = []
+        self.accepted = 0      # connections accepted (rule index space)
+        self.dropped = 0       # connections refused (drop rule/refuse_new)
+        self.bytes_c2s = 0
+        self.bytes_s2c = 0
+        self._refusing = False
+        self._stop = threading.Event()
+        self._srv = socket.create_server((host, port))
+        self.port = self._srv.getsockname()[1]
+        self.addr = (host, self.port)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+
+    # ---- rule management ------------------------------------------------ #
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def clear_rules(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def refuse_new(self, refusing: bool = True) -> None:
+        """Black-hole (accept+close) every NEW connection until lifted —
+        the persistent half of a partition; live pairs are untouched."""
+        self._refusing = refusing
+
+    def sever_all(self) -> int:
+        """Hard-close every live connection pair at once (both sides, both
+        directions) — the instantaneous half of a partition. Returns how
+        many pairs were cut."""
+        with self._lock:
+            pairs, self._pairs = self._pairs, []
+        for c, u in pairs:
+            for s in (c, u):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        return len(pairs)
+
+    def _match(self, idx: int) -> Optional[FaultRule]:
+        with self._lock:
+            for r in self._rules:
+                if r.conn is not None and r.conn != idx:
+                    continue
+                if r.max_conns is not None and r.hits >= r.max_conns:
+                    continue
+                r.hits += 1
+                return r
+        return None
+
+    # ---- data plane ----------------------------------------------------- #
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.1)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # refusal is handled BEFORE the connection enters the rule
+            # index space: a refused connection must consume neither a
+            # rule's conn index nor its max_conns budget, or rule firing
+            # would depend on how many retries land inside the refusal
+            # window — goodbye determinism
+            if self._refusing:
+                self.dropped += 1
+                conn.close()
+                continue
+            idx = self.accepted
+            self.accepted += 1
+            rule = self._match(idx)
+            if rule is not None and rule.action == "drop":
+                self.dropped += 1
+                conn.close()
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=5.0)
+            except OSError:
+                conn.close()
+                continue
+            with self._lock:
+                self._pairs.append((conn, up))
+            for src, dst, c2s in ((conn, up, True), (up, conn, False)):
+                threading.Thread(target=self._pump,
+                                 args=(src, dst, rule, c2s),
+                                 daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              rule: Optional[FaultRule], c2s: bool) -> None:
+        budget = None
+        if rule is not None and rule.action in ("truncate", "sever") and c2s:
+            budget = max(0, rule.after_bytes)
+        forwarded = 0
+        try:
+            while not self._stop.is_set():
+                data = src.recv(65536)
+                if not data:
+                    break
+                if rule is not None and rule.action == "delay" \
+                        and rule.delay_s > 0:
+                    time.sleep(rule.delay_s)
+                if budget is not None and forwarded + len(data) >= budget:
+                    cut = data[:budget - forwarded]
+                    if cut:
+                        dst.sendall(cut)
+                        self.bytes_c2s += len(cut)
+                    break  # -> finally closes BOTH sides: the torn frame
+                dst.sendall(data)
+                forwarded += len(data)
+                if c2s:
+                    self.bytes_c2s += len(data)
+                else:
+                    self.bytes_s2c += len(data)
+        except OSError:
+            pass
+        finally:
+            # closing both sockets finishes the sibling pump too — a cut is
+            # always a FULL connection loss, never a half-open zombie
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            with self._lock:
+                self._pairs = [(c, u) for c, u in self._pairs
+                               if c is not src and c is not dst]
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self.sever_all()

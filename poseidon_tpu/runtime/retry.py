@@ -1,0 +1,57 @@
+"""Shared retry policy: exponential backoff with full jitter.
+
+Every transient-failure loop in the runtime (async-SSP client connect and
+reconnect, cluster rendezvous) routes through this one helper so the policy
+— capped exponential backoff, full jitter (sleep ~ U(0, min(cap, base*2^k)),
+the AWS-architecture-blog rule that avoids reconnect thundering herds after
+a parameter-service restart) — lives in exactly one place. The previous
+client connect loop was a fixed 50 ms poll against a wall-clock deadline;
+under a mass reconnect (service restart with N workers) that synchronizes
+every worker's retry into the same 50 ms slots.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+__all__ = ["retry_with_backoff"]
+
+T = TypeVar("T")
+
+
+def retry_with_backoff(
+    fn: Callable[[], T],
+    *,
+    deadline: float,
+    base: float = 0.05,
+    cap: float = 2.0,
+    jitter: bool = True,
+    rng: Optional[random.Random] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    should_stop: Optional[Callable[[], bool]] = None,
+) -> T:
+    """Call ``fn()`` until it returns, the ``deadline`` (seconds from now)
+    passes, or ``should_stop()`` goes true.
+
+    Sleep before attempt k+1 is ``U(0, min(cap, base * 2**k))`` (full
+    jitter); with ``jitter=False`` it is the deterministic envelope
+    ``min(cap, base * 2**k)``. Exceptions outside ``retry_on`` propagate
+    immediately; on deadline exhaustion the LAST retryable exception is
+    re-raised (never swallowed). ``rng`` makes the jitter stream
+    deterministic for tests (e.g. ``random.Random(worker_id)``)."""
+    rng = rng or random.Random()
+    t_end = time.monotonic() + deadline
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on:
+            now = time.monotonic()
+            if now >= t_end or (should_stop is not None and should_stop()):
+                raise
+            envelope = min(cap, base * (2.0 ** attempt))
+            delay = rng.uniform(0.0, envelope) if jitter else envelope
+            time.sleep(min(delay, max(0.0, t_end - now)))
+            attempt += 1

@@ -1,0 +1,604 @@
+"""Fault tolerance for the async-SSP process tier (ISSUE 1).
+
+The reference is fail-fast: any connection error aborts the whole job
+(comm_bus.hpp:22-24) and the SSP read gate blocks until EVERY worker's
+clock advances — one preempted process wedges the cluster. These tests pin
+the elastic semantics that replace it: liveness eviction (survivors'
+gates unblock), exactly-once PUSH replay across reconnects, rejoin, and
+clean surfacing of permanent failure — all exercised deterministically
+through the :mod:`poseidon_tpu.runtime.faults` loopback proxy
+(drop/delay/truncate/sever rules on exact byte counts and connection
+indices, nothing random).
+
+Every socket here binds port 0 on loopback — no fixed ports, no flakes.
+Tests that sleep more than ~5 s carry ``@pytest.mark.slow``.
+"""
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.parallel.async_ssp import (AsyncSSPClient, ParamService,
+                                             _recv_msg, _send_msg,
+                                             run_async_ssp_worker)
+from poseidon_tpu.runtime.faults import FaultProxy, FaultRule
+from poseidon_tpu.runtime.retry import retry_with_backoff
+
+# tight knobs so every reconnect/eviction resolves in test time
+FAST = dict(heartbeat_s=0.1, reconnect_deadline_s=5.0,
+            backoff_base_s=0.01, backoff_cap_s=0.1)
+
+
+def _zeros_params(shape=(2, 2)):
+    return {"fc": {"w": np.zeros(shape, np.float32)}}
+
+
+def _one(shape=(2, 2)):
+    return {"fc": {"w": np.ones(shape, np.float32)}}
+
+
+def _counting_step(worker):
+    def step(params, it):
+        out = {l: {p: v + 1.0 for p, v in ps.items()}
+               for l, ps in params.items()}
+        return out, 0.0
+    return step
+
+
+def _wait_for(pred, timeout_s=10.0, what="condition"):
+    deadline = time.time() + timeout_s
+    while not pred():
+        if time.time() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.01)
+
+
+# --------------------------------------------------------------------------- #
+# retry helper
+# --------------------------------------------------------------------------- #
+
+def test_retry_with_backoff_policy():
+    """Succeeds after transient failures; re-raises the LAST retryable
+    error on deadline exhaustion; non-retryable errors propagate
+    immediately (no sleep, no swallow)."""
+    import random
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("not yet")
+        return 42
+
+    assert retry_with_backoff(flaky, deadline=5.0, base=0.001, cap=0.01,
+                              rng=random.Random(0)) == 42
+    assert len(calls) == 3
+
+    def always() -> None:
+        raise ConnectionRefusedError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError):
+        retry_with_backoff(always, deadline=0.2, base=0.01, cap=0.05)
+    assert time.monotonic() - t0 < 2.0
+
+    def bug() -> None:
+        raise ValueError("not transient")
+
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        retry_with_backoff(bug, deadline=5.0)
+    assert time.monotonic() - t0 < 1.0
+
+
+# --------------------------------------------------------------------------- #
+# service-side liveness / exactly-once / frame containment
+# --------------------------------------------------------------------------- #
+
+def test_gate_unblocks_after_liveness_eviction():
+    """The acceptance property: a worker that hangs (socket open, no
+    traffic) is evicted at the liveness timeout and the survivor's gate
+    unblocks — where the reference would hang until the 120 s backstop."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=2, liveness_timeout_s=0.4)
+    hung = socket.create_connection(("127.0.0.1", svc.port))
+    try:
+        _send_msg(hung, {"kind": "hello", "worker": 1})
+        _recv_msg(hung)
+        cli = AsyncSSPClient(0, ("127.0.0.1", svc.port), staleness=0,
+                             n_workers=2, **FAST)
+        try:
+            cli.push(_one())
+            # s=0: gate(1) needs worker 1 at clock >= 0; it is hung at -1
+            waited = cli.gate(1, timeout_s=30.0)
+            assert 0.1 < waited < 10.0, waited
+            assert 1 in cli.failed
+            assert 1 in svc.failed_workers
+            assert svc.evictions == 1
+        finally:
+            cli.close()
+    finally:
+        hung.close()
+        svc.close()
+
+
+def test_duplicate_push_applied_once():
+    """A replayed flush whose ack was lost must not double-apply: the
+    service dedups on the per-worker sequence number and acks the
+    duplicate without touching the anchor."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    sk = socket.create_connection(("127.0.0.1", svc.port))
+    try:
+        _send_msg(sk, {"kind": "hello", "worker": 0})
+        _recv_msg(sk)
+        msg = {"kind": "push", "worker": 0, "clock": 0, "seq": 0,
+               "delta": _one()}
+        _send_msg(sk, msg)
+        ack1 = _recv_msg(sk)
+        _send_msg(sk, msg)          # the retry after a lost ack
+        ack2 = _recv_msg(sk)
+        assert ack1["dup"] is False
+        assert ack2["dup"] is True
+        np.testing.assert_allclose(svc.anchor["fc"]["w"], 1.0)
+        assert svc.applied_seq[0] == 0
+        assert svc.clocks[0] == 0
+    finally:
+        sk.close()
+        svc.close()
+
+
+def test_malformed_frames_do_not_kill_service():
+    """A torn header, a mid-message EOF, and an undecodable payload each
+    cost one connection and one logged counter — never the service: a
+    well-behaved client keeps training through all three."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    try:
+        # mid-message EOF: header promises 50 bytes, peer sends 10 and dies
+        bad = socket.create_connection(("127.0.0.1", svc.port))
+        bad.sendall(struct.pack("!Q", 50) + b"0123456789")
+        bad.close()
+        # undecodable payload: complete frame, garbage bytes
+        bad2 = socket.create_connection(("127.0.0.1", svc.port))
+        bad2.sendall(struct.pack("!Q", 4) + b"\x00\x01\x02\x03")
+        bad2.close()
+        # absurd length header (a stray HTTP probe, say)
+        bad3 = socket.create_connection(("127.0.0.1", svc.port))
+        bad3.sendall(b"GET / HT")
+        bad3.close()
+        _wait_for(lambda: svc.bad_frames >= 3, what="bad_frames >= 3")
+
+        cli = AsyncSSPClient(0, ("127.0.0.1", svc.port), staleness=0,
+                             n_workers=1, **FAST)
+        try:
+            cli.push(_one())
+            cli._drain()
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 1.0)
+        finally:
+            cli.close()
+    finally:
+        svc.close()
+
+
+def test_bad_request_shape_is_contained():
+    """A structurally-valid pickle with an unknown kind drops only its
+    own connection (logged), not the per-connection thread's stack into
+    the service."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    sk = socket.create_connection(("127.0.0.1", svc.port))
+    try:
+        _send_msg(sk, {"kind": "no-such-rpc", "worker": 0})
+        _wait_for(lambda: svc.bad_frames >= 1, what="bad request counted")
+        cli = AsyncSSPClient(0, ("127.0.0.1", svc.port), staleness=0,
+                             n_workers=1, **FAST)
+        try:
+            cli.push(_one())
+            cli._drain()
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 1.0)
+        finally:
+            cli.close()
+    finally:
+        sk.close()
+        svc.close()
+
+
+# --------------------------------------------------------------------------- #
+# fault-proxy scenarios (drop / truncate / sever / delay / partition)
+# --------------------------------------------------------------------------- #
+
+def test_proxy_drop_rule_exercises_connect_backoff():
+    """drop: the first two dial attempts see accept-then-close; the
+    client's backoff loop redials and lands the third — training output
+    identical to a clean run."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    proxy.add_rule(FaultRule(action="drop", max_conns=2))
+    try:
+        cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=1,
+                             retry_s=10.0, **FAST)
+        try:
+            cli.push(_one())
+            cli._drain()
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 1.0)
+            assert proxy.dropped == 2
+        finally:
+            cli.close()
+    finally:
+        proxy.close()
+        svc.close()
+
+
+def test_proxy_truncated_frame_is_replayed_exactly_once():
+    """truncate: the push channel is cut 12 bytes into the first PUSH
+    frame. The service contains the torn frame (FrameError, logged, no
+    crash); the client reconnects and replays; the seq dedup guarantees
+    the anchor gets the increment exactly once."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    hello = pickle.dumps({"kind": "hello", "worker": 0},
+                         protocol=pickle.HIGHEST_PROTOCOL)
+    # budget: the whole hello frame + 12 bytes — deterministically inside
+    # the first push frame (conn 0 is the push channel: it dials first)
+    proxy.add_rule(FaultRule(action="truncate", conn=0,
+                             after_bytes=len(hello) + 8 + 12))
+    try:
+        cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=1,
+                             **FAST)
+        try:
+            cli.push(_one())
+            cli._drain(timeout_s=10.0)
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 1.0)
+            assert svc.applied_seq[0] == 0
+            assert svc.bad_frames >= 1      # the torn frame was seen+logged
+            assert cli.reconnects >= 1
+        finally:
+            cli.close()
+    finally:
+        proxy.close()
+        svc.close()
+
+
+def test_reconnect_after_sever_resumes_correct_values():
+    """sever_all: a hard mid-run partition of every live connection. Both
+    channels redial through the proxy; the un-acked flush replays; pull
+    traffic resumes; parameter values are exactly a clean run's."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    try:
+        cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=1,
+                             **FAST)
+        try:
+            cli.push(_one())
+            cli._drain()
+            assert proxy.sever_all() >= 1
+            cli.push(_one())            # hits the dead socket -> reconnect
+            cli._drain(timeout_s=10.0)
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 2.0)
+            assert svc.applied_seq[0] == 1
+            assert cli.reconnects >= 1
+            cache, clocks = cli.refresh()   # pull channel recovers too
+            np.testing.assert_allclose(cache["fc"]["w"], 2.0)
+            assert clocks[0] == 1
+        finally:
+            cli.close()
+    finally:
+        proxy.close()
+        svc.close()
+
+
+def test_proxy_delay_slow_is_not_dead():
+    """delay: a congested path adds latency to every chunk; heartbeats
+    still flow, so the liveness monitor must NOT evict the slow-but-alive
+    worker (slow != dead)."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.8)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    proxy.add_rule(FaultRule(action="delay", delay_s=0.05))
+    try:
+        cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=1,
+                             **FAST)
+        try:
+            for _ in range(3):
+                cli.push(_one())
+            cli._drain(timeout_s=10.0)
+            time.sleep(1.2)             # > liveness timeout of idle silence
+            assert 0 not in svc.failed_workers
+            assert svc.evictions == 0
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 3.0)
+        finally:
+            cli.close()
+    finally:
+        proxy.close()
+        svc.close()
+
+
+def test_permanent_failure_surfaces_to_training_loop():
+    """When the partition outlives the reconnect deadline the failure
+    must reach the TRAINING LOOP as an exception — never a silently dead
+    sender thread quietly dropping oplogs."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    try:
+        cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=1,
+                             heartbeat_s=0.05, reconnect_deadline_s=0.3,
+                             backoff_base_s=0.01, backoff_cap_s=0.05)
+        try:
+            cli.push(_one())
+            cli._drain()
+            proxy.refuse_new()          # the partition persists...
+            proxy.sever_all()           # ...and cuts every live channel
+            cli.push(_one())            # sender hits the wall
+            _wait_for(lambda: cli.dead is not None, timeout_s=10.0,
+                      what="sender thread to surface permanent failure")
+            with pytest.raises(RuntimeError, match="never applied"):
+                cli.push(_one())
+            with pytest.raises(RuntimeError):
+                cli.gate(3)
+        finally:
+            cli.close()
+    finally:
+        proxy.close()
+        svc.close()
+
+
+def test_drain_timeout_raises_never_swallows():
+    """_drain expiry must RAISE: a quiet return would let mark_done()/
+    close() declare the run complete while the final flush is still
+    un-acked — silent update loss. (The sender here is mid-reconnect with
+    a LONG deadline, so self.dead stays None and only the drain's own
+    timeout can fire.)"""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    try:
+        cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=1,
+                             heartbeat_s=0.05, reconnect_deadline_s=30.0,
+                             backoff_base_s=0.01, backoff_cap_s=0.05)
+        try:
+            cli.push(_one())
+            cli._drain()
+            proxy.refuse_new()
+            proxy.sever_all()
+            cli.push(_one())            # un-ackable while refused
+            with pytest.raises(RuntimeError, match="un-acked"):
+                cli._drain(timeout_s=0.5)
+            proxy.refuse_new(False)     # lift: the replay lands after all
+            cli._drain(timeout_s=10.0)
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 2.0)
+        finally:
+            cli.close()
+    finally:
+        proxy.close()
+        svc.close()
+
+
+def test_refused_connections_do_not_consume_rule_budget():
+    """Determinism: reconnect attempts landing inside a refuse_new window
+    must burn neither a rule's max_conns budget nor its conn index — the
+    conn=0 rule fires on the first FORWARDED connection after the window
+    lifts, replay after replay."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    rule = proxy.add_rule(FaultRule(action="drop", conn=0, max_conns=1))
+    proxy.refuse_new()
+    try:
+        for _ in range(3):              # retries inside the refusal window
+            s = socket.create_connection(proxy.addr)
+            assert s.recv(1) == b""     # refused: accept-then-close
+            s.close()
+        assert rule.hits == 0           # budget untouched
+        proxy.refuse_new(False)
+        cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=1,
+                             **FAST)    # first dial eats the drop rule
+        try:
+            assert rule.hits == 1
+            cli.push(_one())
+            cli._drain()
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 1.0)
+        finally:
+            cli.close()
+    finally:
+        proxy.close()
+        svc.close()
+
+
+def test_fault_config_defaults_resolve_into_service_and_client():
+    """`config.set_fault_config` (the programmatic knob surface the
+    ARCHITECTURE doc advertises) must be what None-valued constructor
+    knobs resolve against, and must reject unknown knob names."""
+    from poseidon_tpu import config
+
+    defaults = config.FaultConfig()
+    config.set_fault_config(liveness_timeout_s=0.25, heartbeat_s=0.05)
+    try:
+        svc = ParamService(_zeros_params(), n_workers=1)
+        try:
+            assert svc.liveness_timeout_s == 0.25
+            cli = AsyncSSPClient(0, ("127.0.0.1", svc.port), staleness=0,
+                                 n_workers=1)
+            try:
+                assert cli.heartbeat_s == 0.05
+                assert cli.reconnect_deadline_s == \
+                    defaults.reconnect_deadline_s
+            finally:
+                cli.close()
+        finally:
+            svc.close()
+        with pytest.raises(AttributeError):
+            config.set_fault_config(no_such_knob=1.0)
+    finally:
+        config.set_fault_config(
+            heartbeat_s=defaults.heartbeat_s,
+            liveness_timeout_s=defaults.liveness_timeout_s)
+
+
+def test_socket_tier_importable_without_jax():
+    """A plain-socket worker process (the chaos-drive children, any
+    ParamService-only host) must be able to import the tier and its
+    runtime helpers without paying the jax import — runtime/__init__
+    resolves its heavy re-exports lazily."""
+    import subprocess
+    import sys
+    code = (
+        "import sys\n"
+        "import poseidon_tpu.parallel.async_ssp\n"
+        "import poseidon_tpu.runtime.retry\n"
+        "import poseidon_tpu.runtime.faults\n"
+        "import poseidon_tpu.runtime.metrics\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into socket tier'\n"
+        "from poseidon_tpu.runtime import latest_snapshot  # lazy re-export\n"
+        "print('ok')\n"
+    )
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert p.stdout.strip() == "ok"
+
+
+def test_async_tier_restart_resumes_push_stream(monkeypatch):
+    """The PRODUCT restart path (`train --async_ssp` relaunched after
+    preemption): a fresh AsyncSSPTier must resume this worker's push-seq
+    stream past the service's applied high-water mark — a client naively
+    restarting at seq 0 would have every post-restart flush swallowed by
+    the exactly-once dedup, training healthy-looking but contributing
+    nothing."""
+    import types
+
+    from poseidon_tpu.runtime.async_tier import AsyncSSPTier
+
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=2, liveness_timeout_s=0.0)
+    monkeypatch.setenv("POSEIDON_PROC_ID", "1")
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "2")
+    monkeypatch.delenv("POSEIDON_COORDINATOR", raising=False)
+
+    def fake_engine(p):
+        eng = types.SimpleNamespace()
+        eng.params = p
+        eng.train_step = types.SimpleNamespace(replicated=None)
+        return eng
+
+    def bump(tree):
+        return {l: {p: np.asarray(v) + 1.0 for p, v in ps.items()}
+                for l, ps in tree.items()}
+
+    try:
+        tier = AsyncSSPTier(params, staleness=10, service_port=svc.port)
+        try:
+            assert tier.client.clock == -1          # nothing applied yet
+            eng = fake_engine(bump(tier.resume_cache))
+            tier.after_iters(eng, 1)                # flush clock 0 (seq 0)
+            tier.client._drain()
+            assert svc.applied_seq[1] == 0
+        finally:
+            # preemption: sockets torn down, no bye, no done
+            tier.client._stop.set()
+            tier.client._sender.join(timeout=5.0)
+            tier.client._push_sock.close()
+            tier.client._pull_sock.close()
+
+        # the relaunched process builds a fresh tier against the same
+        # service: it must rejoin at the applied clock, not at -1
+        tier2 = AsyncSSPTier(params, staleness=10, service_port=svc.port)
+        try:
+            assert tier2.client.clock == 0
+            assert tier2.client._acked_clock == 0
+            np.testing.assert_allclose(tier2.resume_cache["fc"]["w"], 1.0)
+            eng2 = fake_engine(bump(tier2.resume_cache))
+            tier2.after_iters(eng2, 1)              # flush clock 1 (seq 1)
+            tier2.client._drain()
+            assert svc.applied_seq[1] == 1          # NOT deduped
+            np.testing.assert_allclose(svc.anchor["fc"]["w"], 2.0)
+        finally:
+            tier2.client.close()
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------------------- #
+# the end-to-end chaos scenario (acceptance criteria)
+# --------------------------------------------------------------------------- #
+
+def test_chaos_kill_one_of_three_mid_run_then_rejoin():
+    """One of three workers is hard-dropped mid-run (sever + persistent
+    refusal — the proxy-level SIGKILL): survivors' gates unblock via
+    eviction and they complete all clocks; the victim's training loop
+    gets the failure as an exception; a restarted process rejoins from
+    the anchor and contributes its remaining clocks. Exactly-once apply
+    makes the final anchor deterministic: every (worker, clock) pair
+    lands exactly once — 3 workers x 12 clocks = 36 increments."""
+    n, n_clocks = 3, 12
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=n, liveness_timeout_s=0.6)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    opts = dict(heartbeat_s=0.1, reconnect_deadline_s=0.3,
+                backoff_base_s=0.01, backoff_cap_s=0.05)
+    results, errs = {}, {}
+
+    def go(w, **kw):
+        try:
+            results[w] = run_async_ssp_worker(
+                w, n, params, _counting_step(w), n_clocks, staleness=2,
+                client_opts=opts, **kw)
+        except Exception as e:  # noqa: BLE001 — the simulated process death
+            errs[w] = e
+
+    threads = {
+        0: threading.Thread(target=go, args=(0,),
+                            kwargs={"service": svc}),
+        1: threading.Thread(target=go, args=(1,),
+                            kwargs={"service": svc}),
+        # the doomed worker routes through the proxy, slightly slow so the
+        # cut lands mid-run
+        2: threading.Thread(target=go, args=(2,),
+                            kwargs={"service_addr": proxy.addr,
+                                    "slow_s": 0.03}),
+    }
+    try:
+        for t in threads.values():
+            t.start()
+        _wait_for(lambda: svc.clocks[2] >= 2, timeout_s=30.0,
+                  what="worker 2 to apply a few clocks")
+        proxy.refuse_new()
+        proxy.sever_all()
+        for t in threads.values():
+            t.join(timeout=60.0)
+        assert not any(t.is_alive() for t in threads.values())
+
+        # survivors completed every clock — their gates excluded the
+        # evicted worker instead of wedging on its frozen clock
+        assert 0 in results and 1 in results, errs
+        assert results[0]["final_clock"] == n_clocks - 1
+        assert results[1]["final_clock"] == n_clocks - 1
+        # the victim's loop got the failure as an exception
+        assert isinstance(errs[2], (RuntimeError, OSError))
+        assert 2 in svc.failed_workers
+        applied = svc.clocks[2]
+        assert 0 <= applied < n_clocks - 1
+
+        # "restart the process": lift the partition, rejoin, finish
+        proxy.refuse_new(False)
+        res2 = run_async_ssp_worker(
+            2, n, params, _counting_step(2), n_clocks, staleness=2,
+            service_addr=proxy.addr, rejoin=True, client_opts=opts)
+        assert res2["start_clock"] == applied + 1
+        assert res2["final_clock"] == n_clocks - 1
+        assert 2 not in svc.failed_workers
+        assert svc.rejoins >= 1
+        np.testing.assert_allclose(svc.anchor["fc"]["w"],
+                                   np.full((2, 2), float(n * n_clocks)))
+    finally:
+        proxy.close()
+        svc.close()
