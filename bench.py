@@ -695,5 +695,112 @@ def main() -> None:
     emit(payload)
 
 
+# --------------------------------------------------------------------------- #
+# serving mode: `python bench.py serving`
+# --------------------------------------------------------------------------- #
+
+SERVING_P99_TARGET_MS = 50.0   # vs_baseline anchor: an interactive-serving
+#                                p99 budget; vs_baseline = target / measured
+#                                (>1 means under budget), same
+#                                higher-is-better orientation as the
+#                                training metric.
+
+
+def serving_main() -> None:
+    """Serving latency microbenchmark: in-process InferenceServer (port 0)
+    driven by serving/client.py's load generator. Emits the same ONE-JSON-
+    line contract as the training bench — {"metric", "value", "unit",
+    "vs_baseline", ...extras} — with p50/p99/throughput/shed/batch-fill.
+
+    Env knobs: POSEIDON_BENCH_CPU=1 (explicit CPU smoke, labeled),
+    POSEIDON_BENCH_SERVE_REQUESTS/_CONCURRENCY/_BATCH/_BUCKETS,
+    POSEIDON_BENCH_SERVE_MODEL/_WEIGHTS (deploy prototxt + snapshot; the
+    default is the CLI's built-in synthetic conv net)."""
+    cpu_ok = os.environ.get("POSEIDON_BENCH_CPU", "") == "1"
+
+    def fail_serving(error: str, probe: dict | None = None) -> None:
+        payload = {"metric": "serving_p99_ms", "value": 0.0, "unit": "ms",
+                   "vs_baseline": 0.0, "error": error}
+        if probe:
+            payload["probe"] = probe
+        emit(payload)
+        sys.exit(1)
+
+    if cpu_ok:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        probe = {"platform": "cpu", "device_kind": "cpu",
+                 "n": None, "smoke": True}
+    else:
+        probe_timeout = float(os.environ.get("POSEIDON_BENCH_PROBE_TIMEOUT",
+                                             "180"))
+        attempts = int(os.environ.get("POSEIDON_BENCH_PROBE_ATTEMPTS", "3"))
+        probe = probe_backend(probe_timeout, attempts)
+        if "platform" not in probe:
+            fail_serving(f"backend unavailable after {attempts} attempts: "
+                         f"{probe.get('error')}", probe)
+        if probe["platform"] not in ("tpu", "axon"):
+            fail_serving(
+                f"refusing to report {probe['platform']!r} as a TPU serving "
+                f"number (set POSEIDON_BENCH_CPU=1 for explicit CPU smoke)",
+                probe)
+
+    n_requests = int(os.environ.get("POSEIDON_BENCH_SERVE_REQUESTS", "400"))
+    concurrency = int(os.environ.get("POSEIDON_BENCH_SERVE_CONCURRENCY", "8"))
+    batch = int(os.environ.get("POSEIDON_BENCH_SERVE_BATCH", "8"))
+    buckets = os.environ.get("POSEIDON_BENCH_SERVE_BUCKETS", "1,4,16,64")
+    model = os.environ.get("POSEIDON_BENCH_SERVE_MODEL", "")
+    weights = os.environ.get("POSEIDON_BENCH_SERVE_WEIGHTS", "")
+
+    try:
+        from poseidon_tpu.runtime.cli import (_build_serving_executor,
+                                              run_serving_bench)
+
+        t0 = time.perf_counter()
+        executor = _build_serving_executor(model, weights, buckets)
+        warm_s = time.perf_counter() - t0
+        result, stats = run_serving_bench(
+            executor, n_requests, concurrency, batch,
+            max_queue=max(64, concurrency * 8))
+    except Exception as e:  # noqa: BLE001 — one JSON line on every path
+        import traceback
+        fail_serving(f"{type(e).__name__}: {e} | "
+                     f"{traceback.format_exc().strip().splitlines()[-1]}",
+                     probe)
+        return
+
+    if not result.get("ok") or result.get("p99_ms") is None:
+        # a run where every request shed/errored must FAIL loudly, not
+        # report value 0.0 as if it were a fast success
+        fail_serving(
+            f"no successful requests (ok={result.get('ok')}, "
+            f"shed={result.get('shed')}, errors={result.get('error')})",
+            probe)
+        return
+    p99 = result.get("p99_ms") or 0.0
+    emit({
+        "metric": "serving_p99_ms",
+        "value": p99,
+        "unit": "ms",
+        "vs_baseline": round(SERVING_P99_TARGET_MS / p99, 3) if p99 else 0.0,
+        "p50_ms": result.get("p50_ms"),
+        "mean_ms": result.get("mean_ms"),
+        "throughput_rps": result.get("throughput_rps"),
+        "requests": n_requests,
+        "concurrency": concurrency,
+        "shed": result.get("shed"),
+        "errors": result.get("error"),
+        "batch_fill": stats.get("batch_fill"),
+        "batches": stats.get("batches"),
+        "bucket_calls": stats.get("bucket_calls"),
+        "aot_warm_s": round(warm_s, 3),
+        "platform": probe.get("platform"),
+        "cpu_smoke": cpu_ok,
+    })
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        serving_main()
+    else:
+        main()

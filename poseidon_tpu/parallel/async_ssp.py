@@ -73,17 +73,17 @@ the service: the offending connection is logged and dropped
 
 from __future__ import annotations
 
-import io
-import pickle
 import queue
 import random
 import socket
-import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..proto.wire import (FrameError, recv_frame as _recv_msg,
+                          send_frame as _send_msg)
 
 __all__ = ["ParamService", "AsyncSSPClient", "run_async_ssp_worker",
            "FrameError"]
@@ -100,59 +100,8 @@ def _log(msg: str) -> None:
     _rlog(msg)
 
 
-# --------------------------------------------------------------------------- #
-# framing
-# --------------------------------------------------------------------------- #
-
-class FrameError(ConnectionError):
-    """Malformed or truncated wire frame (mid-message EOF, oversized
-    length, undecodable pickle). A ConnectionError subclass so client
-    recovery treats it like any other dead-channel signal, while the
-    service can log it distinctly instead of dying in the handler."""
-
-
-# A garbage 8-byte header read as a length is astronomically large (ASCII
-# bytes decode to ~10^16); cap frames so it fails fast as a FrameError
-# instead of an attempted multi-petabyte recv.
-_MAX_FRAME = 1 << 32
-
-
-def _send_msg(sock: socket.socket, obj) -> None:
-    buf = io.BytesIO()
-    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-    data = buf.getvalue()
-    sock.sendall(struct.pack("!Q", len(data)) + data)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    want = n
-    while want:
-        c = sock.recv(min(want, 1 << 20))
-        if not c:
-            if want == n:
-                raise ConnectionError("peer closed")
-            raise FrameError(f"mid-message EOF ({n - want}/{n} bytes)")
-        chunks.append(c)
-        want -= len(c)
-    return b"".join(chunks)
-
-
-def _recv_msg(sock: socket.socket):
-    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
-    if n > _MAX_FRAME:
-        raise FrameError(f"frame length {n} exceeds cap {_MAX_FRAME}")
-    try:
-        payload = _recv_exact(sock, n)
-    except FrameError:
-        raise
-    except ConnectionError as e:
-        # header arrived, payload did not: mid-message, not a clean close
-        raise FrameError(f"mid-message EOF in payload ({e})") from e
-    try:
-        return pickle.loads(payload)
-    except Exception as e:  # noqa: BLE001 — any undecodable payload
-        raise FrameError(f"bad frame payload: {type(e).__name__}: {e}") from e
+# framing: proto/wire.py's length-prefixed frames (FrameError, send_frame,
+# recv_frame), imported above under this module's historical names.
 
 
 def _tree_add(a: Dict, b: Dict) -> None:

@@ -6,11 +6,16 @@ Used for binary compatibility with the reference's serialized artifacts:
 (schema: ``/root/reference/src/caffe/proto/caffe.proto``).
 
 Only the wire-level primitives plus hand-rolled (de)serializers for the handful
-of messages we exchange with Caffe-format files.
+of messages we exchange with Caffe-format files — plus the length-prefixed
+socket framing shared by every host-driven socket tier (the async-SSP
+parameter service and the serving front-end).
 """
 
 from __future__ import annotations
 
+import io
+import pickle
+import socket
 import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -25,6 +30,66 @@ WIRETYPE_32BIT = 5
 
 class WireError(ValueError):
     pass
+
+
+# --------------------------------------------------------------------------- #
+# Length-prefixed socket framing (the host socket tier's wire format):
+# 8-byte big-endian length + pickled payload over TCP on the launcher's
+# control network (trusted, same trust domain as jax.distributed's own
+# channel). Containment contract: a malformed or truncated frame raises
+# FrameError so the receiving service can log and drop ONE connection
+# instead of dying in its handler.
+# --------------------------------------------------------------------------- #
+
+class FrameError(ConnectionError):
+    """Malformed or truncated wire frame (mid-message EOF, oversized
+    length, undecodable pickle). A ConnectionError subclass so client
+    recovery treats it like any other dead-channel signal, while the
+    service can log it distinctly instead of dying in the handler."""
+
+
+# A garbage 8-byte header read as a length is astronomically large (ASCII
+# bytes decode to ~10^16); cap frames so it fails fast as a FrameError
+# instead of an attempted multi-petabyte recv.
+MAX_FRAME = 1 << 32
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    data = buf.getvalue()
+    sock.sendall(struct.pack("!Q", len(data)) + data)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    want = n
+    while want:
+        c = sock.recv(min(want, 1 << 20))
+        if not c:
+            if want == n:
+                raise ConnectionError("peer closed")
+            raise FrameError(f"mid-message EOF ({n - want}/{n} bytes)")
+        chunks.append(c)
+        want -= len(c)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket):
+    (n,) = struct.unpack("!Q", recv_exact(sock, 8))
+    if n > MAX_FRAME:
+        raise FrameError(f"frame length {n} exceeds cap {MAX_FRAME}")
+    try:
+        payload = recv_exact(sock, n)
+    except FrameError:
+        raise
+    except ConnectionError as e:
+        # header arrived, payload did not: mid-message, not a clean close
+        raise FrameError(f"mid-message EOF in payload ({e})") from e
+    try:
+        return pickle.loads(payload)
+    except Exception as e:  # noqa: BLE001 — any undecodable payload
+        raise FrameError(f"bad frame payload: {type(e).__name__}: {e}") from e
 
 
 def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:

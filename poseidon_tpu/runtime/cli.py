@@ -358,6 +358,178 @@ def cmd_time(args) -> int:
     return 0
 
 
+# --------------------------------------------------------------------------- #
+# serving tier (poseidon_tpu/serving/)
+# --------------------------------------------------------------------------- #
+
+_BENCH_SERVE_NET = """
+name: "bench_serve_synthetic"
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 32 input_dim: 32
+layers { name: "conv1" type: CONVOLUTION bottom: "data" top: "conv1"
+  convolution_param { num_output: 16 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers { name: "pool1" type: POOLING bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layers { name: "fc" type: INNER_PRODUCT bottom: "pool1" top: "fc"
+  inner_product_param { num_output: 10 weight_filler { type: "xavier" } } }
+layers { name: "prob" type: SOFTMAX bottom: "fc" top: "prob" }
+"""
+
+
+def _build_serving_executor(model: str, weights: str, buckets: str):
+    """Shared by serve/bench_serve: deploy net (or the built-in synthetic
+    one) + optional weights -> warmed BucketedExecutor."""
+    from ..serving.executor import BucketedExecutor, parse_buckets
+    bucket_sizes = parse_buckets(buckets)
+    if model:
+        return BucketedExecutor.from_files(model, weights or None,
+                                           buckets=bucket_sizes)
+    import jax
+    from ..core.net import Net
+    from ..proto.messages import load_net_from_string
+    net = Net(load_net_from_string(_BENCH_SERVE_NET), "TEST")
+    params = net.init(jax.random.PRNGKey(0))
+    if weights:
+        from ..serving.executor import load_serving_params
+        params = load_serving_params(net, params, weights)
+    return BucketedExecutor(net, params, buckets=bucket_sizes)
+
+
+def cmd_serve(args) -> int:
+    """Serve a trained snapshot over TCP: dynamic micro-batching, a
+    shape-bucketed AOT compile cache, checkpoint hot-reload, and graceful
+    drain on SIGTERM/SIGINT (exit 0, no request silently dropped)."""
+    import json
+    import signal
+
+    from ..serving.reloader import CheckpointReloader
+    from ..serving.server import InferenceServer
+    from .metrics import log
+
+    watch = args.watch
+    if watch == "auto":
+        # derive the snapshot prefix from the weights path:
+        # out/snap/lenet_iter_500.solverstate.npz -> out/snap/lenet
+        if args.weights and "_iter_" in args.weights:
+            watch = args.weights.split("_iter_")[0]
+        else:
+            # refusing beats silently serving without the reloader the
+            # operator asked for; checked BEFORE the (slow) bucket warm-up
+            raise SystemExit(
+                "--watch auto needs --weights pointing at a "
+                "<prefix>_iter_N artifact to derive the prefix from; "
+                "pass the snapshot prefix explicitly instead")
+    executor = _build_serving_executor(args.model, args.weights, args.buckets)
+    log(f"serve: warmed buckets {executor.buckets} "
+        f"({executor.net.name or 'net'}, "
+        f"{executor.net.param_count()} params)")
+    reloader = None
+    if watch:
+        # when --weights is itself a snapshot under the watch prefix, seed
+        # the reloader with it so the first poll only swaps to something
+        # strictly newer (never a redundant or backwards swap)
+        serving_snap = (args.weights if args.weights
+                        and "_iter_" in args.weights
+                        and args.weights.split("_iter_")[0] == watch
+                        else None)
+        reloader = CheckpointReloader(executor, watch, poll_s=args.poll_s,
+                                      current_path=serving_snap)
+        log(f"serve: watching {watch!r} for newer snapshots "
+            f"(every {args.poll_s}s)")
+    if args.host not in ("127.0.0.1", "localhost", "::1"):
+        log(f"serve: WARNING: binding {args.host!r} — the wire format is "
+            f"pickled frames (arbitrary code execution for anyone who can "
+            f"connect); serve only on loopback or a trusted network")
+    server = InferenceServer(
+        executor, host=args.host, port=args.port,
+        max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
+        default_deadline_s=(args.deadline_ms / 1e3
+                            if args.deadline_ms > 0 else None),
+        reloader=reloader)
+    log(f"serve: listening on {server.host}:{server.port}")
+
+    def _graceful(signum, frame):
+        log(f"serve: signal {signum}; draining in-flight requests")
+        # the handler only flips flags; the drain (thread joins) runs on
+        # the main thread below — not signal-handler work
+        server.request_stop()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        server.wait_until_stopped()
+    except KeyboardInterrupt:
+        pass
+    server.shutdown(drain=True)
+    print(json.dumps({"serving_final_stats": server.stats_snapshot()}),
+          flush=True)
+    return 0
+
+
+def run_serving_bench(executor, requests: int, concurrency: int, batch: int,
+                      max_delay_ms: float = 5.0, max_queue: int = 64,
+                      deadline_ms=None):
+    """The in-process serving bench driver shared by `bench_serve` and
+    bench.py's serving mode: port-0 server + the load generator, request
+    sizes cycling 1..batch over the bucket ladder. Returns
+    (run_load result, server stats snapshot)."""
+    import numpy as np
+
+    from ..serving.client import run_load
+    from ..serving.server import InferenceServer
+
+    server = InferenceServer(executor, max_delay_s=max_delay_ms / 1e3,
+                             max_queue=max_queue)
+    name = executor.input_names[0]
+    row_shape = tuple(executor.net.blob_shapes[name][1:])
+    max_rows = max(1, min(batch, executor.max_batch))
+    frames = np.random.RandomState(0).randn(
+        max_rows, *row_shape).astype(np.float32)
+
+    def make_inputs(i):
+        return {name: frames[: 1 + i % max_rows]}
+
+    try:
+        result = run_load(server.addr, make_inputs, n_requests=requests,
+                          concurrency=concurrency, deadline_ms=deadline_ms)
+        stats = server.stats_snapshot()
+    finally:
+        server.shutdown()
+    return result, stats
+
+
+def cmd_bench_serve(args) -> int:
+    """In-process serving latency microbenchmark: stand the server up on
+    port 0, drive it with the shared load generator, print ONE JSON line
+    (p50/p99/throughput + shed/fill telemetry)."""
+    import json
+
+    executor = _build_serving_executor(args.model, args.weights, args.buckets)
+    result, stats = run_serving_bench(
+        executor, args.requests, args.concurrency, args.batch,
+        max_delay_ms=args.max_delay_ms, max_queue=args.max_queue,
+        deadline_ms=args.deadline_ms if args.deadline_ms > 0 else None)
+    result["batch_fill"] = stats["batch_fill"]
+    result["batches"] = stats["batches"]
+    result["bucket_calls"] = stats["bucket_calls"]
+    if not result.get("ok") or result.get("p99_ms") is None:
+        # every request shed/errored: fail loudly, never a clean 0.0 line
+        # (spread result FIRST — it carries an integer "error" counter that
+        # must not clobber the diagnostic string)
+        print(json.dumps({**result, "metric": "serving_p99_ms",
+                          "value": 0.0, "unit": "ms",
+                          "error_counts": result.get("error"),
+                          "error": "no successful requests"}),
+              flush=True)
+        return 1
+    print(json.dumps({"metric": "serving_p99_ms",
+                      "value": result["p99_ms"],
+                      "unit": "ms", **result}), flush=True)
+    return 0
+
+
 def cmd_convert_imageset(args) -> int:
     from .tools import convert_imageset
     convert_imageset(args.listfile, args.out_db, root_folder=args.root_folder,
@@ -549,6 +721,56 @@ def build_parser() -> argparse.ArgumentParser:
 
     dq = sub.add_parser("device_query", help="show accelerator info")
     dq.set_defaults(fn=cmd_device_query)
+
+    sv = sub.add_parser(
+        "serve", help="serve a trained snapshot over TCP (dynamic "
+                      "micro-batching, bucketed AOT compile cache, "
+                      "checkpoint hot-reload)")
+    sv.add_argument("--model", required=True,
+                    help="deploy-style prototxt (explicit input/input_dim)")
+    sv.add_argument("--weights", default="",
+                    help="a .caffemodel or .solverstate.npz to serve; "
+                         "empty serves filler init (smoke mode)")
+    sv.add_argument("--watch", default="",
+                    help="snapshot prefix to poll for hot-reload (e.g. "
+                         "out/snap/lenet), or 'auto' to derive it from "
+                         "--weights' _iter_ naming")
+    sv.add_argument("--host", default="127.0.0.1",
+                    help="bind address; the protocol is pickle-framed and "
+                         "UNAUTHENTICATED — loopback/trusted networks only")
+    sv.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral (printed at startup)")
+    sv.add_argument("--buckets", default="1,4,16,64",
+                    help="batch bucket ladder; every bucket is AOT-"
+                         "compiled at startup (no trace on a request)")
+    sv.add_argument("--max_delay_ms", type=float, default=5.0,
+                    help="micro-batcher flush deadline: a queued request "
+                         "never waits longer than this for batch company")
+    sv.add_argument("--max_queue", type=int, default=64,
+                    help="admission bound; a full queue sheds explicitly")
+    sv.add_argument("--deadline_ms", type=float, default=0.0,
+                    help="default per-request deadline (0 = none)")
+    sv.add_argument("--poll_s", type=float, default=1.0,
+                    help="hot-reload watch cadence")
+    sv.set_defaults(fn=cmd_serve)
+
+    bs = sub.add_parser(
+        "bench_serve", help="serving latency microbenchmark (in-process "
+                            "server + load generator, ONE JSON line)")
+    bs.add_argument("--model", default="",
+                    help="deploy prototxt; empty uses a built-in synthetic "
+                         "conv net")
+    bs.add_argument("--weights", default="")
+    bs.add_argument("--buckets", default="1,4,16,64")
+    bs.add_argument("--requests", type=int, default=200)
+    bs.add_argument("--concurrency", type=int, default=4)
+    bs.add_argument("--batch", type=int, default=8,
+                    help="request sizes cycle 1..batch (exercises the "
+                         "bucket ladder)")
+    bs.add_argument("--max_delay_ms", type=float, default=5.0)
+    bs.add_argument("--max_queue", type=int, default=64)
+    bs.add_argument("--deadline_ms", type=float, default=0.0)
+    bs.set_defaults(fn=cmd_bench_serve)
 
     ci = sub.add_parser("convert_imageset", help="image list -> LMDB")
     ci.add_argument("listfile")

@@ -11,9 +11,10 @@ them per display window and writes the same artifact shapes (CSV + YAML).
 from __future__ import annotations
 
 import os
+import threading
 import time
-from collections import defaultdict
-from typing import Dict, List
+from collections import defaultdict, deque
+from typing import Dict, List, Optional
 
 
 class MetricsTable:
@@ -91,6 +92,53 @@ class StatsRegistry:
             for name in sorted(self.sections):
                 f.write(f"{name}:\n")
                 self._write_tree(f, self.sections[name], 1)
+
+
+class LatencyWindow:
+    """Sliding-window latency percentiles for the serving tier.
+
+    A bounded deque of the last ``maxlen`` samples (seconds): O(1) record
+    on the hot path, sort-on-read only when someone asks for a summary —
+    the `/stats` op, not the request path. Thread-safe (server handler
+    threads record concurrently)."""
+
+    def __init__(self, maxlen: int = 2048):
+        self._samples: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.count = 0            # total ever recorded (window is bounded)
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(float(seconds))
+            self.count += 1
+
+    @staticmethod
+    def _rank(data: List[float], q: float) -> float:
+        """Nearest-rank percentile over sorted ``data`` (one formula, used
+        by percentile() and summary() alike)."""
+        return data[max(0, min(len(data) - 1,
+                               int(round(q / 100.0 * (len(data) - 1)))))]
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Nearest-rank percentile (q in [0, 100]) over the window, in
+        seconds; None while empty."""
+        with self._lock:
+            data = sorted(self._samples)
+        return self._rank(data, q) if data else None
+
+    def summary(self) -> Dict[str, float]:
+        """{count, p50_ms, p99_ms, mean_ms} over the window (empty -> just
+        count=0) — the serving `/stats` payload shape."""
+        with self._lock:
+            data = sorted(self._samples)
+        if not data:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "p50_ms": round(self._rank(data, 50.0) * 1e3, 3),
+            "p99_ms": round(self._rank(data, 99.0) * 1e3, 3),
+            "mean_ms": round(sum(data) / len(data) * 1e3, 3),
+        }
 
 
 def log(msg: str, *, rank: int = 0) -> None:

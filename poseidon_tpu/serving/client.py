@@ -1,0 +1,201 @@
+"""Blocking serving client + the load generator shared by bench and tests.
+
+Transport recovery rides ``runtime/retry.retry_with_backoff`` (capped
+exponential backoff, full jitter — the same policy the async-SSP client
+uses): a connection that dies mid-request is redialed and the request
+RESENT, which is safe because ``infer`` is read-only/idempotent — the
+kill-mid-request chaos test pins exactly this path. Application-level
+refusals are NOT retried here: a shed response is the server's explicit
+backpressure signal and surfaces to the caller as :class:`ServingError`
+with ``shed=True`` — retrying into a full queue is the caller's policy
+decision, not the transport's.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..proto.wire import recv_frame, send_frame
+from ..runtime.metrics import LatencyWindow
+from ..runtime.retry import retry_with_backoff
+
+__all__ = ["ServingClient", "ServingError", "run_load"]
+
+
+class ServingError(RuntimeError):
+    """A structured refusal from the server (shed / deadline / bad
+    request). ``shed`` and ``deadline_exceeded`` mirror the reply flags."""
+
+    def __init__(self, message: str, *, shed: bool = False,
+                 deadline_exceeded: bool = False):
+        super().__init__(message)
+        self.shed = shed
+        self.deadline_exceeded = deadline_exceeded
+
+
+class ServingClient:
+    """One connection, blocking RPCs, transparent reconnect-and-resend."""
+
+    def __init__(self, addr: Tuple[str, int], connect_deadline_s: float = 10.0,
+                 retry_deadline_s: float = 10.0,
+                 backoff_base_s: float = 0.02, backoff_cap_s: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        self.addr = tuple(addr)
+        self.retry_deadline_s = retry_deadline_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._rng = rng or random.Random()
+        self.reconnects = 0
+        self._sock = retry_with_backoff(
+            self._dial, deadline=connect_deadline_s, base=backoff_base_s,
+            cap=backoff_cap_s, rng=self._rng, retry_on=(OSError, EOFError))
+
+    def _dial(self) -> socket.socket:
+        sk = socket.create_connection(self.addr, timeout=5.0)
+        sk.settimeout(None)   # established: block (slow != dead)
+        return sk
+
+    def _rpc(self, msg: Dict) -> Dict:
+        try:
+            send_frame(self._sock, msg)
+            return recv_frame(self._sock)
+        except (OSError, EOFError) as e:
+            # dead channel mid-request: redial and RESEND (idempotent ops
+            # only ride this client), with backoff + jitter
+            first_err = e
+
+        def attempt() -> Dict:
+            sk = self._dial()
+            try:
+                send_frame(sk, msg)
+                out = recv_frame(sk)
+            except BaseException:
+                sk.close()
+                raise
+            old, self._sock = self._sock, sk
+            try:
+                old.close()
+            except OSError:
+                pass
+            return out
+
+        try:
+            reply = retry_with_backoff(
+                attempt, deadline=self.retry_deadline_s,
+                base=self.backoff_base_s, cap=self.backoff_cap_s,
+                rng=self._rng, retry_on=(OSError, EOFError))
+        except (OSError, EOFError) as e:
+            raise ConnectionError(
+                f"server unreachable after {self.retry_deadline_s}s "
+                f"(first error: {type(first_err).__name__}: {first_err})"
+            ) from e
+        self.reconnects += 1
+        return reply
+
+    # ---- ops -------------------------------------------------------------- #
+    def infer(self, inputs: Dict[str, np.ndarray],
+              deadline_ms: Optional[float] = None) -> Dict[str, np.ndarray]:
+        msg: Dict = {"kind": "infer", "inputs": inputs}
+        if deadline_ms is not None:
+            msg["deadline_ms"] = float(deadline_ms)
+        reply = self._rpc(msg)
+        if not reply.get("ok"):
+            raise ServingError(
+                str(reply.get("error", "request refused")),
+                shed=bool(reply.get("shed")),
+                deadline_exceeded=bool(reply.get("deadline_exceeded")))
+        return reply["outputs"]
+
+    def stats(self) -> Dict:
+        reply = self._rpc({"kind": "stats"})
+        if not reply.get("ok"):
+            raise ServingError(str(reply.get("error", "stats refused")))
+        return reply["stats"]
+
+    def health(self) -> Dict:
+        return self._rpc({"kind": "health"})
+
+    def reload(self) -> Dict:
+        return self._rpc({"kind": "reload"})
+
+    def close(self) -> None:
+        try:
+            send_frame(self._sock, {"kind": "bye"})
+        except (OSError, EOFError):
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------- #
+# load generator (bench.py serving mode, `bench_serve`, and the tests)
+# --------------------------------------------------------------------------- #
+
+def run_load(addr: Tuple[str, int],
+             make_inputs: Callable[[int], Dict[str, np.ndarray]],
+             n_requests: int = 200, concurrency: int = 4,
+             deadline_ms: Optional[float] = None,
+             retry_deadline_s: float = 10.0) -> Dict:
+    """Drive ``n_requests`` inferences through ``concurrency`` persistent
+    client connections; returns p50/p99/throughput plus shed/error counts.
+
+    ``make_inputs(i)`` builds request i's input dict (vary batch sizes to
+    exercise the bucket ladder). Sheds are counted, not retried — a bench
+    that silently retried its way around backpressure would report a
+    throughput the server cannot actually sustain."""
+    lat = LatencyWindow(maxlen=max(2048, n_requests))
+    counters = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+    counters_lock = threading.Lock()
+    next_i = {"v": 0}
+
+    def worker() -> None:
+        cli = ServingClient(addr, retry_deadline_s=retry_deadline_s)
+        try:
+            while True:
+                with counters_lock:
+                    i = next_i["v"]
+                    if i >= n_requests:
+                        return
+                    next_i["v"] = i + 1
+                t0 = time.monotonic()
+                try:
+                    cli.infer(make_inputs(i), deadline_ms=deadline_ms)
+                    lat.record(time.monotonic() - t0)
+                    key = "ok"
+                except ServingError as e:
+                    key = ("shed" if e.shed else
+                           "deadline" if e.deadline_exceeded else "error")
+                except (ConnectionError, OSError):
+                    key = "error"
+                with counters_lock:
+                    counters[key] += 1
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, concurrency))]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(time.monotonic() - t_start, 1e-9)
+    summary = lat.summary()
+    return {
+        **counters,
+        "requests": n_requests,
+        "concurrency": concurrency,
+        "wall_s": round(wall, 4),
+        "throughput_rps": round(counters["ok"] / wall, 2),
+        "p50_ms": summary.get("p50_ms"),
+        "p99_ms": summary.get("p99_ms"),
+        "mean_ms": summary.get("mean_ms"),
+    }

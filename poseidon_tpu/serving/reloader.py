@@ -1,0 +1,115 @@
+"""Checkpoint hot-reload: watch the snapshot directory, swap params live.
+
+The training side writes ``<prefix>_iter_N.solverstate.npz`` atomically
+(tmp + rename, runtime/checkpoint.snapshot); discovery reuses
+``runtime/ckpt_files.latest_snapshot``, whose suffix match ignores the
+``.tmp.<pid>`` litter a killed writer leaves behind — a path this reloader
+sees is by construction a COMPLETE rename-landed artifact. Torn or
+incompatible files are still handled: a failed load is logged, counted,
+and the server keeps serving the previous params (serving availability
+never depends on the health of the newest checkpoint).
+
+The load runs on this reloader's own thread — never a request thread —
+and the handoff is ``executor.swap_params``: one atomic reference swap,
+validated against the serving tree. In-flight requests that already
+grabbed the old params finish on them; no request is dropped or errored
+by a reload (pinned by tests/test_serving.py::test_hot_reload_mid_stream).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ..runtime.ckpt_files import latest_snapshot
+from ..runtime.metrics import log
+from .executor import load_serving_params
+
+__all__ = ["CheckpointReloader"]
+
+
+class CheckpointReloader:
+    """Poll ``prefix`` for a newer solverstate and hot-swap the executor.
+
+    ``prefix`` is the snapshot prefix exactly as the solver writes it
+    (e.g. ``out/snap/lenet``); ``poll_s`` is the watch cadence. Starts its
+    thread on construction; ``check_now()`` forces one poll synchronously
+    (the server's ``reload`` op and the tests use it — determinism beats
+    sleeping on the poll period)."""
+
+    def __init__(self, executor, prefix: str, poll_s: float = 1.0,
+                 start: bool = True, current_path: Optional[str] = None):
+        """``current_path`` seeds the already-serving snapshot (the one
+        --weights loaded): the first poll then only swaps to something
+        strictly NEWER, instead of redundantly re-loading the snapshot
+        already serving (or regressing to an older one)."""
+        self.executor = executor
+        self.prefix = prefix
+        self.poll_s = float(poll_s)
+        self.current_path = current_path
+        self.reloads = 0
+        self.failed_reloads = 0
+        self.last_error: Optional[str] = None
+        self._lock = threading.Lock()     # one load at a time
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(target=self._watch_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check_now()
+            except Exception as e:  # noqa: BLE001 — the watcher must survive
+                # discovery itself failed (unreadable watch dir, NFS
+                # outage): as loud as a failed load, or hot-reload dies
+                # silently while the operator believes it is live
+                err = f"{type(e).__name__}: {e}"
+                if err != self.last_error:
+                    log(f"serving: snapshot watch on {self.prefix!r} "
+                        f"failing: {err}")
+                self.last_error = err
+                self.failed_reloads += 1
+
+    def check_now(self) -> bool:
+        """One poll: if a snapshot newer than the one serving exists, load
+        it off-thread and swap. Returns True iff a swap happened."""
+        with self._lock:
+            path = latest_snapshot(self.prefix)
+            if path is None or path == self.current_path:
+                return False
+            if self.current_path is not None and \
+                    self._iter_of(path) <= self._iter_of(self.current_path):
+                return False
+            try:
+                params = load_serving_params(self.executor.net,
+                                             self.executor._params, path)
+                version = self.executor.swap_params(params)
+            except Exception as e:  # noqa: BLE001 — keep serving old params
+                self.failed_reloads += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                log(f"serving: reload of {os.path.basename(path)} failed "
+                    f"({self.last_error}); keeping previous params")
+                return False
+            self.current_path = path
+            self.reloads += 1
+            self.last_error = None
+            log(f"serving: hot-reloaded {os.path.basename(path)} "
+                f"(params version {version})")
+            return True
+
+    @staticmethod
+    def _iter_of(path: str) -> int:
+        name = os.path.basename(path)
+        try:
+            return int(name.split("_iter_")[-1].split(".")[0])
+        except ValueError:
+            return -1
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

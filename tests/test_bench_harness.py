@@ -77,3 +77,49 @@ def test_cpu_smoke_emits_full_line():
         partial = json.load(f)
     assert "alexnet" in partial["sections_done"]
     assert partial["alexnet_step_ms"] > 0
+
+
+def _run_bench_serving(env_extra, timeout=420):
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    env.update(env_extra)
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                        "serving"],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=REPO)
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {r.stdout!r}"
+    return r.returncode, json.loads(lines[0])
+
+
+def test_serving_mode_refuses_silent_cpu():
+    """`bench.py serving` keeps the no-silent-CPU contract: without the
+    explicit smoke flag on a CPU-only machine it fails with the structured
+    serving line."""
+    rc, payload = _run_bench_serving({"POSEIDON_BENCH_PROBE_TIMEOUT": "60",
+                                      "POSEIDON_BENCH_PROBE_ATTEMPTS": "1"})
+    assert rc != 0
+    assert payload["metric"] == "serving_p99_ms"
+    assert payload["value"] == 0.0
+    assert "refusing" in payload["error"] or "unavailable" in payload["error"]
+
+
+@pytest.mark.slow
+def test_serving_mode_cpu_smoke_emits_full_line():
+    """Explicit CPU smoke: rc 0, the BENCH line shape, and the serving
+    extras (p50/p99/throughput/batch_fill) all present."""
+    rc, payload = _run_bench_serving({
+        "POSEIDON_BENCH_CPU": "1",
+        "POSEIDON_BENCH_SERVE_REQUESTS": "40",
+        "POSEIDON_BENCH_SERVE_CONCURRENCY": "2",
+        "POSEIDON_BENCH_SERVE_BUCKETS": "1,2,4"})
+    assert rc == 0
+    assert payload["metric"] == "serving_p99_ms"
+    assert payload["unit"] == "ms"
+    assert payload["value"] > 0 and payload["vs_baseline"] > 0
+    assert payload["p50_ms"] is not None
+    assert payload["throughput_rps"] > 0
+    assert payload["cpu_smoke"] is True and payload["platform"] == "cpu"

@@ -3,6 +3,7 @@
 import os
 
 import numpy as np
+import pytest
 
 N_DEV = 8
 
@@ -722,3 +723,84 @@ random_seed: 11
         finally:
             eng.close()
     assert abs(losses[1] - losses[3]) < 5e-5, losses
+
+
+# --------------------------------------------------------------------------- #
+# runtime/metrics.py direct unit tests (ISSUE 2 satellite): previously only
+# exercised indirectly through Engine runs.
+# --------------------------------------------------------------------------- #
+
+def test_metrics_table_flush_row_averages_and_clears():
+    from poseidon_tpu.runtime.metrics import MetricsTable
+
+    t = MetricsTable("train")
+    t.accumulate({"loss": 2.0, "acc": 0.5})
+    t.accumulate({"loss": 4.0, "acc": 1.0})
+    row = t.flush_row(10)
+    assert row["iter"] == 10
+    assert row["loss"] == 3.0 and row["acc"] == 0.75
+    assert "time" in row
+    # the window cleared: the next flush averages only NEW samples
+    t.accumulate({"loss": 10.0})
+    row2 = t.flush_row(20)
+    assert row2["loss"] == 10.0 and "acc" not in row2
+    assert [r["iter"] for r in t.rows] == [10, 20]
+
+
+def test_metrics_table_to_csv_union_columns(tmp_path):
+    from poseidon_tpu.runtime.metrics import MetricsTable
+
+    t = MetricsTable("train")
+    t.accumulate({"loss": 1.0})
+    t.flush_row(1)
+    t.accumulate({"loss": 2.0, "acc": 0.5})   # a column appears later
+    t.flush_row(2)
+    path = tmp_path / "out" / "m.csv"
+    t.to_csv(str(path))
+    lines = path.read_text().strip().splitlines()
+    header = lines[0].split(",")
+    assert header[:2] == ["iter", "time"] and "acc" in header
+    first = dict(zip(header, lines[1].split(",")))
+    assert first["acc"] == ""                 # missing cell stays blank
+    second = dict(zip(header, lines[2].split(",")))
+    assert float(second["acc"]) == 0.5
+
+
+def test_stats_registry_accumulation_and_yaml(tmp_path):
+    from poseidon_tpu.runtime.metrics import StatsRegistry
+
+    s = StatsRegistry()
+    s.add("train_iters")                      # default increment 1.0
+    s.add("train_iters", 4.0)
+    s.add_time("train_step", 0.25)
+    s.add_time("train_step", 0.5)             # add_time ACCUMULATES
+    s.add_time("io", 0.125)
+    s.set_section("comm", {"summary": {"bytes": 128}, "note": None})
+    assert s.counters["train_iters"] == 5.0
+    assert s.timers["train_step"] == 0.75
+    path = tmp_path / "stats.yaml"
+    s.dump_yaml(str(path))
+    text = path.read_text()
+    assert "train_iters: 5.0" in text
+    assert "train_step: 0.75" in text and "io: 0.125" in text
+    assert "comm:" in text and "bytes: 128" in text
+    assert "note: null" in text               # None serializes as yaml null
+
+
+def test_latency_window_percentiles():
+    from poseidon_tpu.runtime.metrics import LatencyWindow
+
+    w = LatencyWindow(maxlen=100)
+    assert w.percentile(50) is None and w.summary() == {"count": 0}
+    for ms in range(1, 101):                  # 1..100 ms
+        w.record(ms / 1e3)
+    assert w.percentile(50) == pytest.approx(0.050, abs=0.002)
+    assert w.percentile(99) == pytest.approx(0.099, abs=0.002)
+    s = w.summary()
+    assert s["count"] == 100
+    assert s["p50_ms"] == pytest.approx(50.0, abs=2.0)
+    assert s["p99_ms"] == pytest.approx(99.0, abs=2.0)
+    # bounded window: old samples age out, count keeps the lifetime total
+    for _ in range(100):
+        w.record(1.0)
+    assert w.percentile(50) == 1.0 and w.summary()["count"] == 200
