@@ -70,7 +70,27 @@ def set_fault_config(**kwargs) -> None:
         setattr(_fault, k, v)
 
 
-def enable_tpu_async_collectives() -> bool:
+# the two libtpu flags async all-reduce fusion needs; checked INDEPENDENTLY
+# (a user may have set either one explicitly, in either polarity)
+_ASYNC_COLLECTIVE_FLAGS = (
+    "xla_tpu_enable_async_collective_fusion_fuse_all_reduce",
+    "xla_enable_async_all_reduce",
+)
+_TRUE_VALUES = ("true", "1")
+
+
+def _flag_value(args: str, name: str):
+    """The explicit value of ``--name=...`` in a LIBTPU_INIT_ARGS string:
+    True / False when present, None when absent. Last occurrence wins
+    (libtpu's own parse order)."""
+    import re
+    val = None
+    for m in re.finditer(r"--%s=(\S+)" % re.escape(name), args):
+        val = m.group(1).lower() in _TRUE_VALUES
+    return val
+
+
+def enable_tpu_async_collectives(check_backend: bool = True) -> bool:
     """Turn on libtpu's async collective fusion for all-reduce — OFF by
     default in libtpu, but it is the TPU backend's mechanism for hiding
     gradient all-reduces behind remaining backward compute (each bucket's
@@ -80,23 +100,40 @@ def enable_tpu_async_collectives() -> bool:
     0 for the end-of-backward fused sync; evidence/aot_tpu/dwbp.json).
     Pair with ``CommConfig.dwbp_bucket_mb`` on multi-chip meshes.
 
+    Each flag is checked INDEPENDENTLY against the existing
+    ``LIBTPU_INIT_ARGS``: an explicitly-set flag is honored in either
+    polarity and NEVER duplicated (appending ``--xla_enable_async_all_
+    reduce=true`` after a user's explicit ``=false`` would hand libtpu a
+    conflicting duplicate — and any explicit ``=false`` marks a deliberate
+    baseline run, so nothing is appended at all). Only flags that are
+    absent are appended, as ``=true``.
+
     Must run BEFORE libtpu initializes (i.e. before jax touches devices);
-    returns False if the flag could not be applied in time."""
+    returns True iff both flags are (or now are) enabled.
+    ``check_backend=False`` skips the too-late detection (the table-driven
+    tests run after jax initialized its CPU backend by construction)."""
     import os
-    flags = ("--xla_tpu_enable_async_collective_fusion_fuse_all_reduce=true"
-             " --xla_enable_async_all_reduce=true")
     cur = os.environ.get("LIBTPU_INIT_ARGS", "")
-    if "async_collective_fusion_fuse_all_reduce" in cur:
-        # the user set the flag explicitly — honor their value either way
-        # (an explicit =false is a deliberate baseline run, not "enabled")
-        return "async_collective_fusion_fuse_all_reduce=true" in cur
-    import sys
-    if "jax" in sys.modules:
-        try:  # passive check only — never triggers (or hangs on) init
-            from jax._src import xla_bridge
-            if xla_bridge._backends:
-                return False  # too late — libtpu read its flags at init
-        except Exception:  # noqa: BLE001 — bridge internals moved: assume ok
-            pass
-    os.environ["LIBTPU_INIT_ARGS"] = (cur + " " + flags).strip()
+    states = {name: _flag_value(cur, name)
+              for name in _ASYNC_COLLECTIVE_FLAGS}
+    if any(v is False for v in states.values()):
+        # an explicit =false is a deliberate baseline run: honor it, append
+        # nothing (a half-enabled pair would be a third config nobody asked
+        # for — and appending =true after the user's =false would hand
+        # libtpu a conflicting duplicate)
+        return False
+    missing = [n for n, v in states.items() if v is None]
+    if not missing:
+        return True  # both explicitly enabled already; nothing to append
+    if check_backend:
+        import sys
+        if "jax" in sys.modules:
+            try:  # passive check only — never triggers (or hangs on) init
+                from jax._src import xla_bridge
+                if xla_bridge._backends:
+                    return False  # too late — libtpu read its flags at init
+            except Exception:  # noqa: BLE001 — bridge internals moved
+                pass
+    add = " ".join(f"--{n}=true" for n in missing)
+    os.environ["LIBTPU_INIT_ARGS"] = (cur + " " + add).strip()
     return True

@@ -37,6 +37,26 @@ LOSS_TYPES = {
 }
 DATA_SOURCE_TYPES = {"DATA", "IMAGE_DATA", "HDF5_DATA", "WINDOW_DATA", "MEMORY_DATA"}
 
+# Layout contract classes for the net-level channels-last plan (core/net.py):
+#   "spatial"   — has a native NHWC implementation (conv/pool/LRN); runs in
+#                 the planned layout with zero boundary transposes.
+#   "agnostic"  — elementwise / structural; correct in ANY layout (axis-
+#                 remapped where the op names a channel axis). Propagates
+#                 its input layout.
+#   "canonical" — the op's semantics are tied to Caffe's NCHW ordering
+#                 (FC flatten, im2col columns, MVN axes, ...); the planner
+#                 inserts a layout conversion at this GENUINE boundary.
+LAYOUT_SPATIAL = "spatial"
+LAYOUT_AGNOSTIC = "agnostic"
+LAYOUT_CANONICAL = "canonical"
+
+
+def _remap_axis(axis: int, layout: str, ndim: int) -> int:
+    """Map a Caffe NCHW-semantics axis onto the physical layout."""
+    if layout != "NHWC" or ndim != 4:
+        return axis
+    return {0: 0, 1: 3, 2: 1, 3: 2}[axis]
+
 
 class ApplyCtx:
     """Per-call context threaded through Layer.apply."""
@@ -55,12 +75,18 @@ class ApplyCtx:
 class Layer:
     TYPE = "NONE"
     N_PARAMS = 0  # informational; actual defs built in setup
+    # layout contract class (see module docstring constants); the safe
+    # default is canonical — an unknown op never silently consumes NHWC
+    LAYOUT_KIND = LAYOUT_CANONICAL
 
     def __init__(self, lp: LayerParameter, phase: str, index: int = 0):
         self.lp = lp
         self.phase = phase
         self.index = index
         self.params: List[ParamDef] = []
+        # physical layout this layer runs in; assigned by the net-level
+        # layout planner (core/net.py), "NCHW" outside an NHWC plan
+        self.run_layout = "NCHW"
 
     @property
     def name(self) -> str:
@@ -122,6 +148,13 @@ def _resolve_hw(single, h, w, default=None, *, what="", layer=""):
 
 class ConvolutionLayer(Layer):
     TYPE = "CONVOLUTION"
+    LAYOUT_KIND = LAYOUT_SPATIAL
+
+    def __init__(self, lp: LayerParameter, phase: str, index: int = 0):
+        super().__init__(lp, phase, index)
+        # fused epilogue: set by the net-level plan when an in-place ReLU
+        # immediately consumes this conv's top (one XLA kernel per conv)
+        self.fused_relu_slope: Optional[float] = None
 
     def setup(self, bottom_shapes):
         cp = self.lp.convolution_param
@@ -149,10 +182,15 @@ class ConvolutionLayer(Layer):
         w = params["w"]
         b = params.get("b") if self.bias_term else None
         if ctx.comm is not None:
+            # taps see the CANONICAL (OIHW) weight — the layout plan never
+            # reshapes params, so DWBP/SFB gradients stay layout-portable
             w = ctx.comm.tap_param(self.name, "w", w)
             if b is not None:
                 b = ctx.comm.tap_param(self.name, "b", b)
-        return [NN.conv2d(x, w, b, self.stride, self.pad, self.group)
+        act = "relu" if self.fused_relu_slope is not None else None
+        return [NN.conv2d(x, w, b, self.stride, self.pad, self.group,
+                          layout=self.run_layout, act=act,
+                          act_slope=self.fused_relu_slope or 0.0)
                 for x in bottoms]
 
 
@@ -192,6 +230,7 @@ class InnerProductLayer(Layer):
 
 class PoolingLayer(Layer):
     TYPE = "POOLING"
+    LAYOUT_KIND = LAYOUT_SPATIAL
 
     def setup(self, bottom_shapes):
         pp = self.lp.pooling_param
@@ -215,18 +254,21 @@ class PoolingLayer(Layer):
 
     def apply(self, params, bottoms, ctx):
         x = bottoms[0]
+        lay = self.run_layout
         if self.method == "MAX":
-            return [NN.max_pool(x, self.kernel, self.stride, self.pad)]
+            return [NN.max_pool(x, self.kernel, self.stride, self.pad, lay)]
         if self.method == "AVE":
-            return [NN.ave_pool(x, self.kernel, self.stride, self.pad)]
+            return [NN.ave_pool(x, self.kernel, self.stride, self.pad, lay)]
         if self.method == "STOCHASTIC":
             return [NN.stochastic_pool(x, self.kernel, self.stride, self.pad,
-                                       ctx.layer_rng(self.index), ctx.train)]
+                                       ctx.layer_rng(self.index), ctx.train,
+                                       lay)]
         raise ValueError(f"unknown pool method {self.method}")
 
 
 class LRNLayer(Layer):
     TYPE = "LRN"
+    LAYOUT_KIND = LAYOUT_SPATIAL
 
     def setup(self, bottom_shapes):
         lp = self.lp.lrn_param
@@ -244,8 +286,10 @@ class LRNLayer(Layer):
             # XLA formulation elsewhere — identical numerics either way
             from ..ops.pallas_kernels import maybe_lrn_fused
             return [maybe_lrn_fused(x, self.local_size, self.alpha,
-                                    self.beta, self.k)]
-        return [NN.lrn_within_channel(x, self.local_size, self.alpha, self.beta)]
+                                    self.beta, self.k,
+                                    layout=self.run_layout)]
+        return [NN.lrn_within_channel(x, self.local_size, self.alpha,
+                                      self.beta, self.run_layout)]
 
 
 class Im2colLayer(Layer):
@@ -273,6 +317,8 @@ class Im2colLayer(Layer):
 # --------------------------------------------------------------------------- #
 
 class _NeuronLayer(Layer):
+    LAYOUT_KIND = LAYOUT_AGNOSTIC
+
     def setup(self, bottom_shapes):
         return [bottom_shapes[0]]
 
@@ -280,7 +326,16 @@ class _NeuronLayer(Layer):
 class ReLULayer(_NeuronLayer):
     TYPE = "RELU"
 
+    def __init__(self, lp: LayerParameter, phase: str, index: int = 0):
+        super().__init__(lp, phase, index)
+        # set by the net-level epilogue-fusion pass: this in-place ReLU was
+        # folded into the producing conv's epilogue; apply is then identity
+        # (the bottom already holds the activated values)
+        self.folded_into: Optional[str] = None
+
     def apply(self, params, bottoms, ctx):
+        if self.folded_into is not None:
+            return [bottoms[0]]
         return [E.relu(bottoms[0], self.lp.relu_param.negative_slope)]
 
 
@@ -329,6 +384,12 @@ class ThresholdLayer(_NeuronLayer):
 
 class DropoutLayer(_NeuronLayer):
     TYPE = "DROPOUT"
+    # the bernoulli mask is drawn over x.shape, so the element<->mask
+    # assignment would depend on the physical layout; canonical keeps the
+    # rng stream layout-portable (bit-identical train steps either way).
+    # CNN dropout sits on FC/post-global-pool blobs where the conversion
+    # is degenerate (XLA folds it to a bitcast), so this costs nothing.
+    LAYOUT_KIND = LAYOUT_CANONICAL
 
     def apply(self, params, bottoms, ctx):
         return [E.dropout(bottoms[0], self.lp.dropout_param.dropout_ratio,
@@ -352,6 +413,7 @@ class FlattenLayer(Layer):
 
 class ConcatLayer(Layer):
     TYPE = "CONCAT"
+    LAYOUT_KIND = LAYOUT_AGNOSTIC  # axis-remapped under NHWC
 
     def setup(self, bottom_shapes):
         self.axis = self.lp.concat_param.concat_dim
@@ -360,11 +422,13 @@ class ConcatLayer(Layer):
         return [tuple(out)]
 
     def apply(self, params, bottoms, ctx):
-        return [E.concat(bottoms, self.axis)]
+        axis = _remap_axis(self.axis, self.run_layout, bottoms[0].ndim)
+        return [E.concat(bottoms, axis)]
 
 
 class SliceLayer(Layer):
     TYPE = "SLICE"
+    LAYOUT_KIND = LAYOUT_AGNOSTIC  # axis-remapped under NHWC
 
     def setup(self, bottom_shapes):
         sp = self.lp.slice_param
@@ -388,11 +452,13 @@ class SliceLayer(Layer):
         return shapes
 
     def apply(self, params, bottoms, ctx):
-        return E.slice_blob(bottoms[0], self.axis, self.points, len(self.lp.top))
+        axis = _remap_axis(self.axis, self.run_layout, bottoms[0].ndim)
+        return E.slice_blob(bottoms[0], axis, self.points, len(self.lp.top))
 
 
 class SplitLayer(Layer):
     TYPE = "SPLIT"
+    LAYOUT_KIND = LAYOUT_AGNOSTIC
 
     def setup(self, bottom_shapes):
         return [bottom_shapes[0]] * len(self.lp.top)
@@ -403,6 +469,7 @@ class SplitLayer(Layer):
 
 class EltwiseLayer(Layer):
     TYPE = "ELTWISE"
+    LAYOUT_KIND = LAYOUT_AGNOSTIC
 
     def setup(self, bottom_shapes):
         return [bottom_shapes[0]]
@@ -417,11 +484,13 @@ class MVNLayer(_NeuronLayer):
 
     def apply(self, params, bottoms, ctx):
         mp = self.lp.mvn_param
-        return [E.mvn(bottoms[0], mp.normalize_variance, mp.across_channels)]
+        return [E.mvn(bottoms[0], mp.normalize_variance, mp.across_channels,
+                      layout=self.run_layout)]
 
 
 class SilenceLayer(Layer):
     TYPE = "SILENCE"
+    LAYOUT_KIND = LAYOUT_AGNOSTIC  # discards its bottoms; any layout is fine
 
     def setup(self, bottom_shapes):
         return []
@@ -432,12 +501,14 @@ class SilenceLayer(Layer):
 
 class SoftmaxLayer(Layer):
     TYPE = "SOFTMAX"
+    LAYOUT_KIND = LAYOUT_AGNOSTIC  # channel-axis remapped under NHWC
 
     def setup(self, bottom_shapes):
         return [bottom_shapes[0]]
 
     def apply(self, params, bottoms, ctx):
-        return [L.softmax(bottoms[0], axis=1)]
+        axis = _remap_axis(1, self.run_layout, bottoms[0].ndim)
+        return [L.softmax(bottoms[0], axis=axis)]
 
 
 class ArgMaxLayer(Layer):
@@ -618,6 +689,9 @@ class DummyDataLayer(Layer):
 
 class HDF5OutputLayer(Layer):
     TYPE = "HDF5_OUTPUT"
+    # no in-graph compute; the engine dumps its bottoms from the
+    # canonicalized blobs dict (Net.apply keep_blobs converts to NCHW)
+    LAYOUT_KIND = LAYOUT_AGNOSTIC
 
     def setup(self, bottom_shapes):
         return []

@@ -12,6 +12,23 @@ collects parameter definitions, and exposes
 separate backward graph, no InsertSplits (multi-consumer blobs are natural in
 a functional graph), and no PS-table plumbing (parameter placement is a
 sharding annotation, handled in ``poseidon_tpu.parallel``).
+
+**Layout plan** (round 6): when the policy (or the per-net override) selects
+channels-last, the WHOLE graph is planned in NHWC at construction time —
+every conv/pool/LRN runs natively channels-last, elementwise/concat/softmax
+layers ride along (axis-remapped), and the plan converts back to canonical
+NCHW only at genuine boundaries: the FC flatten, im2col columns, blob
+export (``keep_blobs``/HDF5 dumps), and 4-D net outputs. Logical shapes
+(``blob_shapes``), parameters, gradients and checkpoints stay canonical
+NCHW/OIHW everywhere, so snapshots are layout-portable and the SFB /
+DWBP taps always see one gradient layout. This replaces the round-3/5
+per-op transpose shims whose boundary pairs did NOT cancel across
+pool/LRN/concat seams (the 0.53x NHWC A/B).
+
+The plan also fuses conv epilogues: an in-place ReLU that immediately
+consumes a conv's top folds into the conv's epilogue (``ops/nn.conv2d``'s
+``act``), so XLA emits one fused kernel per conv layer. The fold is exact —
+``relu(conv + b)`` computed by the same formula — and phase-independent.
 """
 
 from __future__ import annotations
@@ -23,10 +40,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import policy
+from ..ops import nn as NN
 from ..proto.messages import NetParameter, NetState, LayerParameter
 from .blob import ParamDef
 from .fillers import fill
-from .layers import (ApplyCtx, DATA_SOURCE_TYPES, Layer, create_layer)
+from .layers import (ApplyCtx, DATA_SOURCE_TYPES, LAYOUT_AGNOSTIC,
+                     LAYOUT_SPATIAL, Layer, create_layer)
 
 Shape = Tuple[int, ...]
 
@@ -64,11 +84,20 @@ class Net:
         source_shapes: Optional[Dict[str, Shape]] = None,
         level: int = 0,
         stages: Sequence[str] = (),
+        conv_layout: Optional[str] = None,
+        fuse_conv_epilogues: bool = True,
     ):
         self.net_param = net_param
         self.phase = phase
         self.state = NetState(phase=phase, level=level, stage=list(stages))
         self.name = net_param.name
+        # The layout is a GRAPH-level choice, fixed at construction: the
+        # per-net override wins, else the ambient numeric policy's default.
+        # (Ops take explicit layout args; they no longer read the policy.)
+        self.conv_layout = conv_layout or policy().conv_layout
+        if self.conv_layout not in NN.LAYOUTS:
+            raise ValueError(f"unknown conv_layout {self.conv_layout!r}")
+        self.fuse_conv_epilogues = fuse_conv_epilogues
 
         selected = filter_net(net_param, self.state)
         self.source_layer_params: List[LayerParameter] = []
@@ -184,6 +213,65 @@ class Net:
             if owned:
                 self.param_defs[layer.name] = owned
         self._layer_by_name = {l.name: l for l in self.layers}
+        if self.fuse_conv_epilogues:
+            self._plan_epilogues()
+        self._plan_layouts()
+
+    # ------------------------------------------------------------------ #
+    def _plan_epilogues(self) -> None:
+        """Fold each in-place ReLU that immediately consumes a conv's top
+        into the conv's fused epilogue (bias + ReLU in one XLA kernel).
+        Exact: identical formula, identical blob values (in-place ReLU
+        already overwrites the blob, so downstream consumers see the
+        activated values either way). Skipped when any layer touches the
+        blob between the conv and the ReLU, or when the conv's own top
+        carries a loss_weight (the pre-activation sum would change)."""
+        for i, layer in enumerate(self.layers):
+            if layer.TYPE != "CONVOLUTION" or len(layer.lp.top) != 1:
+                continue
+            if layer.lp.loss_weight:
+                continue
+            top = layer.lp.top[0]
+            for nxt in self.layers[i + 1:]:
+                if (nxt.TYPE == "RELU" and nxt.lp.bottom == [top]
+                        and nxt.lp.top == [top]):
+                    layer.fused_relu_slope = nxt.lp.relu_param.negative_slope
+                    nxt.folded_into = layer.name
+                    break
+                if top in nxt.lp.bottom or top in nxt.lp.top:
+                    break
+
+    def _plan_layouts(self) -> None:
+        """Assign each layer's run layout and each external input's entry
+        layout. Under NCHW this is the identity plan. Under NHWC: spatial
+        layers (conv/pool/LRN) run channels-last natively, agnostic layers
+        propagate whatever layout their 4-D bottoms arrived in, and
+        canonical layers (FC flatten, im2col, dropout rng, unknown types)
+        force the genuine NCHW boundary. The walk mirrors ``apply``'s, so
+        apply can replay it to know every blob's physical layout at every
+        program point (in-place chains may re-layout a name mid-net)."""
+        self.input_layouts: Dict[str, str] = {}
+        nhwc = self.conv_layout == "NHWC"
+        for name in self.input_names:
+            four_d = len(self.blob_shapes[name]) == 4
+            self.input_layouts[name] = "NHWC" if (nhwc and four_d) else "NCHW"
+        if not nhwc:
+            return
+        cur = dict(self.input_layouts)
+        for layer in self.layers:
+            b4 = [b for b in layer.lp.bottom
+                  if len(self.blob_shapes[b]) == 4]
+            if layer.LAYOUT_KIND == LAYOUT_SPATIAL:
+                run = "NHWC"
+            elif layer.LAYOUT_KIND == LAYOUT_AGNOSTIC:
+                run = ("NHWC" if b4 and all(cur.get(b, "NCHW") == "NHWC"
+                                            for b in b4) else "NCHW")
+            else:
+                run = "NCHW"
+            layer.run_layout = run
+            for t in layer.lp.top:
+                if len(self.blob_shapes[t]) == 4:
+                    cur[t] = run
 
     def _layer_params(self, params, layer: Layer) -> Dict[str, jax.Array]:
         """Resolve a layer's param dict through the sharing bindings."""
@@ -219,19 +307,49 @@ class Net:
         rng: Optional[jax.Array] = None,
         comm=None,
         keep_blobs: bool = False,
+        input_layout: str = "NCHW",
     ) -> NetOutputs:
+        """``input_layout`` names the physical layout of the CALLER's 4-D
+        input blobs ("NCHW" default — the Caffe contract). Under an NHWC
+        plan, feeding "NHWC" directly (images are naturally HWC; the bench
+        generates device-side) makes the hot path transpose-free; feeding
+        canonical NCHW costs exactly one entry transpose per image input.
+        Outputs and ``keep_blobs`` are ALWAYS canonical NCHW — export,
+        HDF5 dumps and debug tooling never see the internal layout."""
         if train is None:
             train = self.phase == "TRAIN"
         if comm is not None:
             # reset the comm context's per-trace state (DWBP chain tokens)
             getattr(comm, "begin", lambda: None)()
         ctx = ApplyCtx(train=train, rng=rng, comm=comm)
-        blobs: Dict[str, jax.Array] = dict(inputs)
+        # physical layout of every blob at the CURRENT program point (an
+        # in-place chain may re-layout a name mid-net); mirrors the
+        # planner's walk in _plan_layouts
+        cur_layout: Dict[str, str] = {}
+        blobs: Dict[str, jax.Array] = {}
+        for name, val in inputs.items():
+            want = self.input_layouts.get(name, "NCHW")
+            if getattr(val, "ndim", 0) == 4:
+                val = NN.to_layout(val, input_layout, want)
+            blobs[name] = val
+            cur_layout[name] = want
+        converted: Dict[Tuple[str, str], jax.Array] = {}
+
+        def bottom_in(name: str, want: str) -> jax.Array:
+            v = blobs[name]
+            have = cur_layout.get(name, "NCHW")
+            if getattr(v, "ndim", 0) != 4 or have == want:
+                return v
+            key = (name, want)
+            if key not in converted:
+                converted[key] = NN.to_layout(v, have, want)
+            return converted[key]
+
         loss = jnp.zeros((), jnp.float32)
         outputs: Dict[str, jax.Array] = {}
         for layer in self.layers:
             lp = layer.lp
-            bottoms = [blobs[b] for b in lp.bottom]
+            bottoms = [bottom_in(b, layer.run_layout) for b in lp.bottom]
             # layer-scoped HLO metadata: xplane trace events carry the layer
             # name, so one profiled step attributes device time per layer
             # (no per-layer recompiles — the `time --per_layer` alternative
@@ -243,14 +361,26 @@ class Net:
             weights = layer.loss_weights(len(tops))
             for name, val, w in zip(lp.top, tops, weights):
                 blobs[name] = val
+                cur_layout[name] = layer.run_layout
+                converted.pop((name, "NCHW"), None)
+                converted.pop((name, "NHWC"), None)
                 if w:
                     # Caffe sums the whole top blob into the objective when a
-                    # loss_weight is set on a non-scalar top (net.cpp).
+                    # loss_weight is set on a non-scalar top (net.cpp) —
+                    # layout-invariant, so the sum needs no conversion.
                     loss = loss + w * jnp.sum(val.astype(jnp.float32))
+
+        def canonical(name: str) -> jax.Array:
+            v = blobs[name]
+            if getattr(v, "ndim", 0) != 4:
+                return v
+            return NN.to_layout(v, cur_layout.get(name, "NCHW"), "NCHW")
+
         for name in self.output_names:
-            outputs[name] = blobs[name]
-        return NetOutputs(loss=loss, outputs=outputs,
-                          blobs=blobs if keep_blobs else {})
+            outputs[name] = canonical(name)
+        return NetOutputs(
+            loss=loss, outputs=outputs,
+            blobs={k: canonical(k) for k in blobs} if keep_blobs else {})
 
     # ------------------------------------------------------------------ #
     def load_weights(self, params, layer_weights: Dict[str, List[np.ndarray]],

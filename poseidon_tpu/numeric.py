@@ -28,10 +28,17 @@ class Policy:
     param_dtype: object = jnp.float32
     compute_dtype: object = jnp.float32  # flipped to bfloat16 by perf configs
     accum_dtype: object = jnp.float32
-    # Internal conv layout. The external/prototxt contract is always NCHW
-    # (Caffe blobs); "NHWC" transposes around each conv so XLA sees the
-    # TPU-preferred channels-last layout — the transposes sit at op
-    # boundaries where XLA's layout assignment can cancel chains of them.
+    # Internal activation layout — a GRAPH-level choice, not a per-op one:
+    # core/net.py reads this at Net construction (overridable per net via
+    # Net(conv_layout=...)) and plans the WHOLE graph in that layout —
+    # "NHWC" runs every conv/pool/LRN/elementwise/concat natively
+    # channels-last (TPU-preferred) and converts only at genuine
+    # boundaries (FC flatten, blob export). The external/prototxt contract
+    # stays NCHW: logical shapes, params, grads and checkpoints are always
+    # canonical, so snapshots are layout-portable. Ops take explicit
+    # layout arguments; nothing reads this field at trace time. (The old
+    # per-op transpose shim this replaces lost 1.9x: its boundary pairs
+    # did not cancel across pool/LRN/concat seams.)
     conv_layout: str = "NCHW"
     # Space-to-depth stem transform: rewrite few-channel strided convs
     # (AlexNet/GoogLeNet conv1: 3 input channels use 3/128 MXU lanes) as an

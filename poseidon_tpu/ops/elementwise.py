@@ -99,10 +99,15 @@ def eltwise(xs: Sequence[jax.Array], operation: str, coeffs: Sequence[float]):
     raise ValueError(f"unknown eltwise op {operation!r}")
 
 
-def mvn(x, normalize_variance: bool, across_channels: bool, eps: float = 1e-10):
+def mvn(x, normalize_variance: bool, across_channels: bool, eps: float = 1e-10,
+        layout: str = "NCHW"):
     # mvn_layer.cpp: normalize over (C,H,W) if across_channels else (H,W),
-    # per sample; eps added to sqrt(var).
-    axes = (1, 2, 3) if across_channels else (2, 3)
+    # per sample; eps added to sqrt(var). across_channels reduces every
+    # non-batch axis, so only the spatial-only variant is layout-sensitive.
+    if across_channels:
+        axes = (1, 2, 3)
+    else:
+        axes = (1, 2) if layout == "NHWC" else (2, 3)
     mean = jnp.mean(x, axis=axes, keepdims=True)
     centered = x - mean
     if not normalize_variance:

@@ -8,6 +8,18 @@ inner product lower directly onto the MXU via ``lax.conv_general_dilated`` /
 and LRN as a fused elementwise + windowed-sum expression XLA folds into
 neighboring ops.
 
+Layout contract (round 6): every spatial op takes an explicit ``layout``
+("NCHW" | "NHWC") describing the PHYSICAL layout of its activation inputs
+and outputs. There is no per-op transpose shim anymore — the round-3/5
+shim (transpose at every op boundary and hope XLA cancels the pairs) lost
+1.9x because the pairs do NOT cancel across pool/LRN/concat seams. The
+layout is now a graph-level plan owned by ``core/net.py``: the whole net
+runs in one layout and converts only at genuine boundaries (data entry, FC
+flatten, blob export). Conv weights stay canonical OIHW in either layout —
+``dimension_numbers=("NHWC", "OIHW", "NHWC")`` is the zero-cost view that
+presents them to the MXU without a materialized transpose, so params,
+grads, checkpoints and the SFB taps always see one canonical layout.
+
 Numerical semantics follow the reference:
 - conv output size: floor((in + 2*pad - k)/stride) + 1        (conv_layer.cpp)
 - pool output size: ceil((in + 2*pad - k)/stride) + 1, minus one if the last
@@ -31,6 +43,38 @@ from jax import lax
 
 from ..config import matmul_precision, policy
 
+LAYOUTS = ("NCHW", "NHWC")
+
+
+def _check_layout(layout: str) -> str:
+    if layout not in LAYOUTS:
+        raise ValueError(f"unknown layout {layout!r}; choose from {LAYOUTS}")
+    return layout
+
+
+def nchw_to_nhwc(x: jax.Array) -> jax.Array:
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def nhwc_to_nchw(x: jax.Array) -> jax.Array:
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def to_layout(x: jax.Array, src: str, dst: str) -> jax.Array:
+    """Physical layout conversion for a 4-D activation; identity otherwise."""
+    if src == dst or x.ndim != 4:
+        return x
+    return nhwc_to_nchw(x) if src == "NHWC" else nchw_to_nhwc(x)
+
+
+def spatial_axes(layout: str) -> Tuple[int, int]:
+    return (1, 2) if layout == "NHWC" else (2, 3)
+
+
+def channel_axis(layout: str) -> int:
+    return 3 if layout == "NHWC" else 1
+
+
 # --------------------------------------------------------------------------- #
 # Convolution
 # --------------------------------------------------------------------------- #
@@ -40,7 +84,7 @@ def conv_out_size(in_size: int, kernel: int, stride: int, pad: int) -> int:
     return (in_size + 2 * pad - kernel) // stride + 1
 
 
-def _space_to_depth_rewrite(x, w, stride, pad):
+def _space_to_depth_rewrite(x, w, stride, pad, layout: str):
     """Exact rewrite of a few-channel strided conv as a stride-1 conv over
     s*s-times more channels (the MLPerf-era stem trick, here generalized).
 
@@ -50,12 +94,16 @@ def _space_to_depth_rewrite(x, w, stride, pad):
     zero-padding the kernel to a multiple of s gives the identical sum —
     out(i,j) = sum_{c,u,v} w[o,c,u,v] x[c, si+u, sj+v] with u = s*di+ph,
     v = s*dj+pw — so the transform is exact up to float summation order.
+    Both layouts produce the same (c, u, v) channel flattening order, so
+    the rewritten kernel w2 is layout-independent (canonical OIHW).
 
     Returns (x2, w2) for a stride-1, pad-0 conv producing the same output.
     """
     s = stride[0]
-    n, c, h, wd = x.shape
-    o, _, kh, kw = w.shape
+    o, c, kh, kw = w.shape
+    ah, aw = spatial_axes(layout)
+    n = x.shape[0]
+    h, wd = x.shape[ah], x.shape[aw]
     out_h = conv_out_size(h, kh, s, pad[0])
     out_w = conv_out_size(wd, kw, s, pad[1])
     k2h = -(-kh // s) * s
@@ -64,13 +112,23 @@ def _space_to_depth_rewrite(x, w, stride, pad):
     # out_h/out_w windows touch: s*(out-1) + k2
     need_h = s * (out_h - 1) + k2h
     need_w = s * (out_w - 1) + k2w
-    xp = jnp.pad(x, ((0, 0), (0, 0),
-                     (pad[0], max(need_h - h - pad[0], 0)),
-                     (pad[1], max(need_w - wd - pad[1], 0))))
-    xp = xp[:, :, :need_h, :need_w]
-    x2 = xp.reshape(n, c, need_h // s, s, need_w // s, s)
-    x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(
-        n, c * s * s, need_h // s, need_w // s)
+    pads = [(0, 0)] * 4
+    pads[ah] = (pad[0], max(need_h - h - pad[0], 0))
+    pads[aw] = (pad[1], max(need_w - wd - pad[1], 0))
+    xp = jnp.pad(x, pads)
+    lo = [0] * 4
+    hi = list(xp.shape)
+    hi[ah], hi[aw] = need_h, need_w
+    xp = lax.slice(xp, lo, hi)
+    if layout == "NHWC":
+        x2 = xp.reshape(n, need_h // s, s, need_w // s, s, c)
+        # channel flattening order (c, sh, sw) — identical to the NCHW path
+        x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(
+            n, need_h // s, need_w // s, c * s * s)
+    else:
+        x2 = xp.reshape(n, c, need_h // s, s, need_w // s, s)
+        x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(
+            n, c * s * s, need_h // s, need_w // s)
     wp = jnp.pad(w, ((0, 0), (0, 0), (0, k2h - kh), (0, k2w - kw)))
     w2 = wp.reshape(o, c, k2h // s, s, k2w // s, s)
     w2 = w2.transpose(0, 1, 3, 5, 2, 4).reshape(
@@ -78,10 +136,10 @@ def _space_to_depth_rewrite(x, w, stride, pad):
     return x2, w2
 
 
-def _s2d_applicable(x, w, stride, group) -> bool:
+def _s2d_applicable(x, w, stride, group, layout: str) -> bool:
     return (policy().conv_s2d and group == 1 and
             stride[0] == stride[1] and stride[0] >= 2 and
-            x.shape[1] <= 4 and w.shape[2] >= stride[0])
+            x.shape[channel_axis(layout)] <= 4 and w.shape[2] >= stride[0])
 
 
 def conv2d(
@@ -91,45 +149,59 @@ def conv2d(
     stride: Tuple[int, int],
     pad: Tuple[int, int],
     group: int = 1,
+    layout: str = "NCHW",
+    act: Optional[str] = None,
+    act_slope: float = 0.0,
+    scale: Optional[jax.Array] = None,
+    shift: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """NCHW convolution; w is OIHW with I = C/group.
+    """Convolution with a fused epilogue. ``x`` is in ``layout``; ``w`` is
+    ALWAYS canonical OIHW with I = C/group (under NHWC the weight reaches
+    the MXU via the dimension-numbers view, never a materialized
+    transpose, so the stored/updated/checkpointed layout is one and the
+    same). Output is in ``layout``.
 
-    With ``policy().conv_layout == "NHWC"`` the conv itself runs
-    channels-last (TPU-preferred): inputs/outputs transpose at the op
-    boundary, where XLA layout assignment cancels back-to-back transposes
-    between consecutive convs/pools. Interface and results stay NCHW."""
+    Epilogue (fused into the conv consumer so XLA emits one kernel per
+    conv layer): ``y = act((conv(x, w) + b) * scale + shift)``, every
+    piece optional. ``act="relu"`` applies Caffe's ReLU (``negative_slope``
+    via ``act_slope``); ``scale``/``shift`` are per-output-channel vectors
+    (the BN-folded inference epilogue)."""
+    _check_layout(layout)
     p = policy()
     xc = x.astype(p.compute_dtype)
     wc = w.astype(p.compute_dtype)
-    if _s2d_applicable(xc, wc, stride, group):
-        xc, wc = _space_to_depth_rewrite(xc, wc, stride, pad)
+    if _s2d_applicable(xc, wc, stride, group, layout):
+        xc, wc = _space_to_depth_rewrite(xc, wc, stride, pad, layout)
         stride = (1, 1)
         pad = (0, 0)
     padding = [(pad[0], pad[0]), (pad[1], pad[1])]
-    if p.conv_layout == "NHWC":
-        y = lax.conv_general_dilated(
-            jnp.transpose(xc, (0, 2, 3, 1)),
-            wc,
-            window_strides=stride,
-            padding=padding,
-            dimension_numbers=("NHWC", "OIHW", "NHWC"),
-            feature_group_count=group,
-            precision=matmul_precision(),
-        )
-        if b is not None:
-            y = y + b.reshape(1, 1, 1, -1).astype(y.dtype)
-        return jnp.transpose(y, (0, 3, 1, 2))
+    dn = ((layout, "OIHW", layout) if layout == "NHWC"
+          else ("NCHW", "OIHW", "NCHW"))
     y = lax.conv_general_dilated(
         xc,
         wc,
         window_strides=stride,
         padding=padding,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=dn,
         feature_group_count=group,
         precision=matmul_precision(),
     )
+    cshape = (1, 1, 1, -1) if layout == "NHWC" else (1, -1, 1, 1)
     if b is not None:
-        y = y + b.reshape(1, -1, 1, 1).astype(y.dtype)
+        y = y + b.reshape(cshape).astype(y.dtype)
+    if scale is not None:
+        y = y * scale.reshape(cshape).astype(y.dtype)
+    if shift is not None:
+        y = y + shift.reshape(cshape).astype(y.dtype)
+    if act == "relu":
+        # exactly elementwise.relu — folding must be bit-identical to the
+        # unfused conv -> relu sequence it replaces
+        if act_slope == 0.0:
+            y = jnp.maximum(y, 0)
+        else:
+            y = jnp.where(y > 0, y, act_slope * y)
+    elif act is not None:
+        raise ValueError(f"unknown conv epilogue act {act!r}")
     return y
 
 
@@ -139,6 +211,8 @@ def im2col(
     """Patch extraction (the reference's IM2COL layer, util/im2col.cpp).
 
     Returns (N, C*kh*kw, out_h, out_w) matching Caffe's column layout.
+    NCHW only: the column ordering IS the layer's contract, so the layout
+    planner treats IM2COL as a canonical-layout boundary.
     """
     patches = lax.conv_general_dilated_patches(
         x,
@@ -162,8 +236,9 @@ def pool_out_size(in_size: int, kernel: int, stride: int, pad: int) -> int:
     return out
 
 
-def _pool_dims(x, kernel, stride, pad):
-    h, w = x.shape[2], x.shape[3]
+def _pool_dims(x, kernel, stride, pad, layout: str):
+    ah, aw = spatial_axes(layout)
+    h, w = x.shape[ah], x.shape[aw]
     return h, w, pool_out_size(h, kernel[0], stride[0], pad[0]), pool_out_size(
         w, kernel[1], stride[1], pad[1]
     )
@@ -179,14 +254,11 @@ def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
     previous slice-chain formulation transposed into a pile of
     pad-and-add ops — the round-5 cycle attribution put pooling BACKWARD
     at 5x its forward and ~23% of the whole AlexNet step
-    (evidence/aot_tpu/layer_cycles.json). The historical reason for the
-    slice chain — reduce_window not differentiating inside shard_map — no
-    longer holds on current JAX.
+    (evidence/aot_tpu/layer_cycles.json).
 
     ``layout`` selects which axes are spatial: (2, 3) for NCHW, (1, 2) for
-    NHWC (channels-last, the TPU-preferred layout the conv path uses under
-    ``policy().conv_layout == "NHWC"``)."""
-    ah, aw = (1, 2) if layout == "NHWC" else (2, 3)
+    NHWC — the op is layout-native either way (no transposes)."""
+    ah, aw = spatial_axes(layout)
     h, w = x.shape[ah], x.shape[aw]
     hi_h = max((oh - 1) * stride[0] + kernel[0] - pad[0] - h, 0)
     hi_w = max((ow - 1) * stride[1] + kernel[1] - pad[1] - w, 0)
@@ -218,30 +290,18 @@ def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
                              tuple(window), tuple(strides), "VALID")
 
 
-def _pool_layout(x):
-    """(x_in_pool_layout, layout, restore) under the conv layout policy:
-    channels-last pooling keeps the conv->pool->conv chain free of layout
-    changes — the boundary transposes are exact inverses of the adjacent
-    convs' and cancel in XLA (the round-3 NHWC A/B lost 1.9x precisely
-    because pooling/LRN stayed NCHW and every boundary transpose survived)."""
-    if policy().conv_layout == "NHWC":
-        return (jnp.transpose(x, (0, 2, 3, 1)), "NHWC",
-                lambda y: jnp.transpose(y, (0, 3, 1, 2)))
-    return x, "NCHW", lambda y: y
+def max_pool(x, kernel, stride, pad, layout: str = "NCHW"):
+    _check_layout(layout)
+    h, w, oh, ow = _pool_dims(x, kernel, stride, pad, layout)
+    return _window_reduce(x, kernel, stride, pad, oh, ow,
+                          -jnp.inf, jnp.maximum, layout)
 
 
-def max_pool(x, kernel, stride, pad):
-    h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
-    xt, layout, restore = _pool_layout(x)
-    return restore(_window_reduce(xt, kernel, stride, pad, oh, ow,
-                                  -jnp.inf, jnp.maximum, layout))
-
-
-def ave_pool(x, kernel, stride, pad):
-    h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
-    xt, layout, restore = _pool_layout(x)
-    summed = restore(_window_reduce(xt, kernel, stride, pad, oh, ow, 0.0,
-                                    lambda a, b: a + b, layout))
+def ave_pool(x, kernel, stride, pad, layout: str = "NCHW"):
+    _check_layout(layout)
+    h, w, oh, ow = _pool_dims(x, kernel, stride, pad, layout)
+    summed = _window_reduce(x, kernel, stride, pad, oh, ow, 0.0,
+                            lambda a, b: a + b, layout)
     # Caffe's divisor: window clipped to the padded extent [start, in+pad),
     # where start may be negative (pooling_layer.cpp:170-180). Static per
     # position, so compute host-side.
@@ -252,30 +312,33 @@ def ave_pool(x, kernel, stride, pad):
 
     dh = divisors(oh, stride[0], pad[0], kernel[0], h)
     dw = divisors(ow, stride[1], pad[1], kernel[1], w)
-    denom = jnp.asarray(np.outer(dh, dw), x.dtype)
-    return summed / denom
+    denom = np.outer(dh, dw)
+    if layout == "NHWC":
+        denom = denom[:, :, None]  # broadcast over minor channels
+    return summed / jnp.asarray(denom, x.dtype)
 
 
-def global_ave_pool(x):
-    return jnp.mean(x, axis=(2, 3), keepdims=True)
+def global_ave_pool(x, layout: str = "NCHW"):
+    return jnp.mean(x, axis=spatial_axes(layout), keepdims=True)
 
 
-def stochastic_pool(x, kernel, stride, pad, rng, train: bool):
+def stochastic_pool(x, kernel, stride, pad, rng, train: bool,
+                    layout: str = "NCHW"):
     """STOCHASTIC pooling (enum present in the reference; CPU impl was
     NOT_IMPLEMENTED, GPU trains by prob-weighted sampling, tests with the
     prob-weighted average — pooling_layer.cu). x must be non-negative."""
-    h, w, oh, ow = _pool_dims(x, kernel, stride, pad)
+    _check_layout(layout)
+    h, w, oh, ow = _pool_dims(x, kernel, stride, pad, layout)
     if pad != (0, 0):
         raise NotImplementedError("stochastic pooling with padding")
-    xt, layout, restore = _pool_layout(x)
     add = lambda a, b: a + b
-    sum_x = _window_reduce(xt, kernel, stride, pad, oh, ow, 0.0, add, layout)
-    sum_x2 = _window_reduce(xt * xt, kernel, stride, pad, oh, ow, 0.0, add,
+    sum_x = _window_reduce(x, kernel, stride, pad, oh, ow, 0.0, add, layout)
+    sum_x2 = _window_reduce(x * x, kernel, stride, pad, oh, ow, 0.0, add,
                             layout)
     # Prob-weighted average in both phases (the reference's test path; exact
     # multinomial sampling at train time would break cross-replica
     # determinism).
-    return restore(sum_x2 / jnp.maximum(sum_x, jnp.finfo(jnp.float32).tiny))
+    return sum_x2 / jnp.maximum(sum_x, jnp.finfo(jnp.float32).tiny)
 
 
 # --------------------------------------------------------------------------- #
@@ -283,34 +346,29 @@ def stochastic_pool(x, kernel, stride, pad, rng, train: bool):
 # --------------------------------------------------------------------------- #
 
 
-def lrn_across_channels(x, local_size: int, alpha: float, beta: float, k: float = 1.0):
+def lrn_across_channels(x, local_size: int, alpha: float, beta: float,
+                        k: float = 1.0, layout: str = "NCHW"):
+    _check_layout(layout)
     pre_pad = (local_size - 1) // 2
     post_pad = local_size - pre_pad - 1
-    if policy().conv_layout == "NHWC":
-        # channel window on the minor axis, inside the same channels-last
-        # chain as the adjacent convs/pools (boundary transposes cancel)
-        xt = jnp.transpose(x, (0, 2, 3, 1))
-        n, h, w, c = xt.shape
-        sq = jnp.pad(xt * xt, [(0, 0), (0, 0), (0, 0), (pre_pad, post_pad)])
-        windowed = None
-        for dc in range(local_size):
-            sl = lax.slice(sq, (0, 0, 0, dc), (n, h, w, dc + c))
-            windowed = sl if windowed is None else windowed + sl
-        scale = k + (alpha / local_size) * windowed
-        return jnp.transpose(xt * scale ** (-beta), (0, 3, 1, 2))
-    n, c, h, w = x.shape
-    sq = jnp.pad(x * x, [(0, 0), (pre_pad, post_pad), (0, 0), (0, 0)])
+    ca = channel_axis(layout)
+    c = x.shape[ca]
+    pads = [(0, 0)] * 4
+    pads[ca] = (pre_pad, post_pad)
+    sq = jnp.pad(x * x, pads)
     windowed = None
     for dc in range(local_size):
-        sl = lax.slice(sq, (0, dc, 0, 0), (n, dc + c, h, w))
+        sl = lax.slice_in_dim(sq, dc, dc + c, axis=ca)
         windowed = sl if windowed is None else windowed + sl
     scale = k + (alpha / local_size) * windowed
     return x * scale ** (-beta)
 
 
-def lrn_within_channel(x, local_size: int, alpha: float, beta: float):
+def lrn_within_channel(x, local_size: int, alpha: float, beta: float,
+                       layout: str = "NCHW"):
     pre_pad = (local_size - 1) // 2
-    pooled = ave_pool(x * x, (local_size, local_size), (1, 1), (pre_pad, pre_pad))
+    pooled = ave_pool(x * x, (local_size, local_size), (1, 1),
+                      (pre_pad, pre_pad), layout)
     scale = 1.0 + alpha * pooled
     return x * scale ** (-beta)
 
@@ -321,7 +379,11 @@ def lrn_within_channel(x, local_size: int, alpha: float, beta: float):
 
 
 def inner_product(x: jax.Array, w: jax.Array, b: Optional[jax.Array]) -> jax.Array:
-    """x: (N, ...) flattened to (N, K); w: (M, K) as Caffe stores it."""
+    """x: (N, ...) flattened to (N, K); w: (M, K) as Caffe stores it.
+
+    The flatten is Caffe's canonical C-major (C, H, W) order — the layout
+    planner converts NHWC activations back to NCHW before this boundary so
+    the stored weight's K ordering never depends on the activation layout."""
     p = policy()
     x2 = x.reshape(x.shape[0], -1)
     y = lax.dot_general(

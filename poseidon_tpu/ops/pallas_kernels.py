@@ -430,17 +430,28 @@ def maybe_flash_attention(q, k, v, causal: bool = False,
 # --------------------------------------------------------------------------- #
 
 def _lrn_kernel(x_ref, o_ref, *, local_size: int, alpha: float, beta: float,
-                k: float, channels: int):
-    x = x_ref[0].astype(jnp.float32)  # (C, T) — channels x spatial tile
+                k: float, channels: int, channel_axis: int = 0):
+    """One LRN tile. ``channel_axis`` selects the block orientation:
+    0 = (C, T) channels x spatial tile (NCHW), 1 = (T, C) spatial tile x
+    channels (NHWC — the channel window then runs over the MINOR axis,
+    matching the net-level channels-last plan so the kernel needs no
+    operand layout change at its custom-call boundary)."""
+    x = x_ref[0].astype(jnp.float32)
     pre = (local_size - 1) // 2
     sq = x * x
-    padded = jnp.pad(sq, ((pre, local_size - pre - 1), (0, 0)))
+    pads = [(0, 0), (0, 0)]
+    pads[channel_axis] = (pre, local_size - pre - 1)
+    padded = jnp.pad(sq, pads)
     windowed = jnp.zeros_like(sq)
     for dc in range(local_size):
         windowed = windowed + lax.slice_in_dim(padded, dc, dc + channels,
-                                               axis=0)
+                                               axis=channel_axis)
     scale = k + (alpha / local_size) * windowed
     o_ref[0] = (x * scale ** (-beta)).astype(o_ref.dtype)
+
+
+class LRNTileError(ValueError):
+    """No VMEM-legal spatial tiling exists for this channel count."""
 
 
 def _lrn_tile(hw: int, want: int, channels: int) -> tuple:
@@ -455,7 +466,12 @@ def _lrn_tile(hw: int, want: int, channels: int) -> tuple:
        measured +32% est. cycles on AlexNet's norms);
     2. otherwise a 128-multiple tile with the extent padded up and the
        pad sliced off after. LRN windows run over CHANNELS only, so zero
-       spatial padding is inert (scale = k > 0)."""
+       spatial padding is inert (scale = k > 0).
+
+    Raises :class:`LRNTileError` when the VMEM budget caps the tile below
+    128 lanes (channels > ~2560): emitting a 128-wide block anyway would
+    exceed the scoped VMEM limit at Mosaic compile time, so callers must
+    fall back to the XLA formulation instead (``lrn_fused`` does)."""
     # ~8 f32 temps of (C, tile) live on the kernel stack (x, g, sq,
     # padded, windowed, scale, r, out); stay under ~10 MB of the 16 MB
     # scoped VMEM
@@ -463,50 +479,117 @@ def _lrn_tile(hw: int, want: int, channels: int) -> tuple:
     if channels * hw * 4 * 8 <= budget:
         return hw, hw
     cap = budget // (channels * 4 * 8)
+    if cap < 128:
+        raise LRNTileError(
+            f"fused LRN: {channels} channels leave a VMEM tile budget of "
+            f"{cap} < 128 lanes (~8 f32 temps of (C, tile) must fit "
+            f"{budget >> 20} MB); use the XLA formulation for channel "
+            f"counts above ~{budget // (4 * 8 * 128)}")
     want = max(128, (min(want, cap) // 128) * 128)
     padded = -(-hw // want) * want
     return want, padded
 
 
+def lrn_tile_feasible(hw: int, channels: int) -> bool:
+    """Whether a VMEM-legal tiling exists (see ``_lrn_tile``)."""
+    try:
+        _lrn_tile(hw, 512, channels)
+        return True
+    except LRNTileError:
+        return False
+
+
+def _lrn_shape(x, layout: str):
+    """(n, c, hw, reshape-to-3d, restore-from-3d) for either layout; the
+    3-D view keeps channels on the axis the kernel's block expects (major
+    for NCHW, MINOR for NHWC — channels-last stays channels-last through
+    the custom-call boundary, no operand relayout)."""
+    if layout == "NHWC":
+        n, h, w, c = x.shape
+        return (n, c, h * w,
+                lambda a: a.reshape(n, h * w, c),
+                lambda a: a.reshape(n, h, w, c))
+    n, c, h, w = x.shape
+    return (n, c, h * w,
+            lambda a: a.reshape(n, c, h * w),
+            lambda a: a.reshape(n, c, h, w))
+
+
+def _lrn_specs(c: int, tile: int, layout: str):
+    if layout == "NHWC":
+        return pl.BlockSpec((1, tile, c), lambda i, j: (i, j, 0),
+                            memory_space=pltpu.VMEM), 1
+    return pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
+                        memory_space=pltpu.VMEM), 0
+
+
+def _lrn_pad3(x2, hw: int, hw_p: int, layout: str):
+    if hw_p == hw:
+        return x2
+    pad = [(0, 0)] * 3
+    pad[1 if layout == "NHWC" else 2] = (0, hw_p - hw)
+    return jnp.pad(x2, pad)
+
+
+def _lrn_crop3(out, n: int, c: int, hw: int, layout: str):
+    if layout == "NHWC":
+        return lax.slice(out, (0, 0, 0), (n, hw, c))
+    return lax.slice(out, (0, 0, 0), (n, c, hw))
+
+
 def _lrn_fused_fwd_impl(x, local_size: int, alpha: float, beta: float,
-                        k: float, tile: int, interpret: Optional[bool]):
+                        k: float, tile: int, interpret: Optional[bool],
+                        layout: str = "NCHW"):
     if interpret is None:
         interpret = _interpret_default()
-    n, c, h, w = x.shape
-    hw = h * w
+    n, c, hw, to3, from3 = _lrn_shape(x, layout)
     tile, hw_p = _lrn_tile(hw, tile, c)
-    x2 = x.reshape(n, c, hw)
-    if hw_p != hw:
-        x2 = jnp.pad(x2, ((0, 0), (0, 0), (0, hw_p - hw)))
+    x2 = _lrn_pad3(to3(x), hw, hw_p, layout)
+    spec, caxis = _lrn_specs(c, tile, layout)
+    out_shape = ((n, hw_p, c) if layout == "NHWC" else (n, c, hw_p))
     out = pl.pallas_call(
         functools.partial(_lrn_kernel, local_size=local_size, alpha=alpha,
-                          beta=beta, k=k, channels=c),
-        out_shape=jax.ShapeDtypeStruct((n, c, hw_p), x.dtype),
+                          beta=beta, k=k, channels=c, channel_axis=caxis),
+        out_shape=jax.ShapeDtypeStruct(out_shape, x.dtype),
         grid=(n, hw_p // tile),
-        in_specs=[pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
-                               memory_space=pltpu.VMEM),
+        in_specs=[spec],
+        out_specs=spec,
         interpret=interpret,
     )(x2)
-    if hw_p != hw:
-        out = lax.slice(out, (0, 0, 0), (n, c, hw))
-    return out.reshape(n, c, h, w)
+    return from3(_lrn_crop3(out, n, c, hw, layout))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
-def lrn_fused(x, local_size: int, alpha: float, beta: float, k: float = 1.0,
-              tile: int = 512, interpret: Optional[bool] = None):
-    """Fused LRN forward: x (N, C, H, W), one VMEM pass per spatial tile.
-    Backward recomputes through the differentiable XLA formulation
-    (ops/nn.lrn_across_channels) — O(1) residual, matching Caffe's LRN
-    semantics bit-for-bit on the gradient path."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _lrn_fused_cvjp(x, local_size: int, alpha: float, beta: float,
+                    k: float, tile: int, interpret: Optional[bool],
+                    layout: str):
     return _lrn_fused_fwd_impl(x, local_size, alpha, beta, k, tile,
-                               interpret)
+                               interpret, layout)
+
+
+def lrn_fused(x, local_size: int, alpha: float, beta: float, k: float = 1.0,
+              tile: int = 512, interpret: Optional[bool] = None,
+              layout: str = "NCHW"):
+    """Fused LRN: one VMEM pass per spatial tile, forward and analytic
+    backward. ``layout`` selects the block orientation — x is (N, C, H, W)
+    under NCHW, (N, H, W, C) under NHWC (the net-level channels-last plan
+    feeds this directly; no layout round-trip at the custom-call
+    boundary).
+
+    Channel counts whose VMEM working set admits no 128-lane tile
+    (> ~2560 channels, see ``_lrn_tile``) fall back to the XLA
+    formulation — same numbers, no Mosaic scoped-VMEM blowup."""
+    n, c, hw, _, _ = _lrn_shape(x, layout)
+    if not lrn_tile_feasible(hw, c):
+        from .nn import lrn_across_channels
+        return lrn_across_channels(x, local_size, alpha, beta, k, layout)
+    return _lrn_fused_cvjp(x, local_size, alpha, beta, k, tile, interpret,
+                           layout)
 
 
 def _lrn_bwd_kernel(x_ref, g_ref, o_ref, *, local_size: int, alpha: float,
-                    beta: float, k: float, channels: int):
+                    beta: float, k: float, channels: int,
+                    channel_axis: int = 0):
     """One-pass LRN backward (the analytic Caffe gradient,
     lrn_layer.cpp CrossChannelBackward):
 
@@ -517,64 +600,66 @@ def _lrn_bwd_kernel(x_ref, g_ref, o_ref, *, local_size: int, alpha: float,
     window is the forward window mirrored (pad (post, pre) instead of
     (pre, post)). Everything stays in one VMEM tile — the round-5 cycle
     attribution put the recompute-through-XLA backward at ~2/3 of the LRN
-    layers' 29%-of-step cost (evidence/aot_tpu/layer_cycles.json)."""
-    x = x_ref[0].astype(jnp.float32)  # (C, T)
+    layers' 29%-of-step cost (evidence/aot_tpu/layer_cycles.json).
+    ``channel_axis``: see ``_lrn_kernel``."""
+    x = x_ref[0].astype(jnp.float32)
     g = g_ref[0].astype(jnp.float32)
     pre = (local_size - 1) // 2
     post = local_size - pre - 1
     sq = x * x
-    padded = jnp.pad(sq, ((pre, post), (0, 0)))
+    fwd_pads = [(0, 0), (0, 0)]
+    fwd_pads[channel_axis] = (pre, post)
+    padded = jnp.pad(sq, fwd_pads)
     windowed = jnp.zeros_like(sq)
     for dc in range(local_size):
         windowed = windowed + lax.slice_in_dim(padded, dc, dc + channels,
-                                               axis=0)
+                                               axis=channel_axis)
     scale = k + (alpha / local_size) * windowed
     r = g * x * scale ** (-beta - 1.0)
-    rp = jnp.pad(r, ((post, pre), (0, 0)))
+    bwd_pads = [(0, 0), (0, 0)]
+    bwd_pads[channel_axis] = (post, pre)
+    rp = jnp.pad(r, bwd_pads)
     rsum = jnp.zeros_like(r)
     for dc in range(local_size):
-        rsum = rsum + lax.slice_in_dim(rp, dc, dc + channels, axis=0)
+        rsum = rsum + lax.slice_in_dim(rp, dc, dc + channels,
+                                       axis=channel_axis)
     dx = g * scale ** (-beta) - (2.0 * alpha * beta / local_size) * x * rsum
     o_ref[0] = dx.astype(o_ref.dtype)
 
 
 def lrn_fused_bwd(x, g, local_size: int, alpha: float, beta: float,
                   k: float = 1.0, tile: int = 512,
-                  interpret: Optional[bool] = None):
+                  interpret: Optional[bool] = None, layout: str = "NCHW"):
     """Fused LRN backward: dx from (x, g) in one VMEM pass per tile."""
     if interpret is None:
         interpret = _interpret_default()
-    n, c, h, w = x.shape
-    hw = h * w
+    n, c, hw, to3, from3 = _lrn_shape(x, layout)
     tile, hw_p = _lrn_tile(hw, tile, c)
-    x2 = x.reshape(n, c, hw)
-    g2 = g.reshape(n, c, hw)
-    if hw_p != hw:
-        pad = ((0, 0), (0, 0), (0, hw_p - hw))
-        x2 = jnp.pad(x2, pad)
-        g2 = jnp.pad(g2, pad)
-    spec = pl.BlockSpec((1, c, tile), lambda i, j: (i, 0, j),
-                        memory_space=pltpu.VMEM)
+    x2 = _lrn_pad3(to3(x), hw, hw_p, layout)
+    g2 = _lrn_pad3(to3(g), hw, hw_p, layout)
+    spec, caxis = _lrn_specs(c, tile, layout)
+    out_shape = ((n, hw_p, c) if layout == "NHWC" else (n, c, hw_p))
     out = pl.pallas_call(
         functools.partial(_lrn_bwd_kernel, local_size=local_size,
-                          alpha=alpha, beta=beta, k=k, channels=c),
-        out_shape=jax.ShapeDtypeStruct((n, c, hw_p), x.dtype),
+                          alpha=alpha, beta=beta, k=k, channels=c,
+                          channel_axis=caxis),
+        out_shape=jax.ShapeDtypeStruct(out_shape, x.dtype),
         grid=(n, hw_p // tile),
         in_specs=[spec, spec],
         out_specs=spec,
         interpret=interpret,
     )(x2, g2)
-    if hw_p != hw:
-        out = lax.slice(out, (0, 0, 0), (n, c, hw))
-    return out.reshape(n, c, h, w)
+    return from3(_lrn_crop3(out, n, c, hw, layout))
 
 
-def _lrn_fused_vjp_fwd(x, local_size, alpha, beta, k, tile, interpret):
+def _lrn_fused_vjp_fwd(x, local_size, alpha, beta, k, tile, interpret,
+                       layout):
     return _lrn_fused_fwd_impl(x, local_size, alpha, beta, k, tile,
-                               interpret), x
+                               interpret, layout), x
 
 
-def _lrn_fused_vjp_bwd(local_size, alpha, beta, k, tile, interpret, x, g):
+def _lrn_fused_vjp_bwd(local_size, alpha, beta, k, tile, interpret, layout,
+                       x, g):
     if interpret is None:
         interpret = _interpret_default()
     if interpret:
@@ -582,31 +667,34 @@ def _lrn_fused_vjp_bwd(local_size, alpha, beta, k, tile, interpret, x, g):
         # Pallas emulation would only slow the CPU mesh down)
         from .nn import lrn_across_channels
         _, vjp = jax.vjp(
-            lambda x_: lrn_across_channels(x_, local_size, alpha, beta, k),
+            lambda x_: lrn_across_channels(x_, local_size, alpha, beta, k,
+                                           layout),
             x)
         return vjp(g)
     return (lrn_fused_bwd(x, g, local_size, alpha, beta, k, tile,
-                          interpret),)
+                          interpret, layout),)
 
 
-lrn_fused.defvjp(_lrn_fused_vjp_fwd, _lrn_fused_vjp_bwd)
+_lrn_fused_cvjp.defvjp(_lrn_fused_vjp_fwd, _lrn_fused_vjp_bwd)
 
 
 def maybe_lrn_fused(x, local_size: int, alpha: float, beta: float,
-                    k: float = 1.0):
+                    k: float = 1.0, layout: str = "NCHW"):
     """ACROSS_CHANNELS LRN routing. Default: the XLA formulation
     everywhere — the round-5 TPU cost-model A/B
     (evidence/aot_tpu/layer_cycles.json) showed the Pallas kernel's
     operand-layout boundary copies alone cost more than the whole fused
     XLA chain once pooling moved to reduce_window (GoogLeNet 67.1M est
     cycles XLA vs 78.3M Pallas-with-unmodeled-kernel; AlexNet's norm1
-    attribution under Pallas was ~25% of the step, nearly all copies).
-    ``POSEIDON_PALLAS_LRN=1`` opts back into the Pallas fwd+bwd kernels —
-    kept for the live-chip wall-clock A/B that can overrule a cost
-    model."""
+    attribution under Pallas was ~25% of the step, nearly all copies —
+    the NHWC kernel entry removes exactly that round-trip for the
+    channels-last plan). ``POSEIDON_PALLAS_LRN=1`` opts back into the
+    Pallas fwd+bwd kernels — kept for the live-chip wall-clock A/B that
+    can overrule a cost model. Channel counts beyond the VMEM tiling cap
+    (see ``_lrn_tile``) always take the XLA formulation."""
     import os
     from .nn import lrn_across_channels
     if not _interpret_default() and \
             os.environ.get("POSEIDON_PALLAS_LRN") == "1":
-        return lrn_fused(x, local_size, alpha, beta, k)
-    return lrn_across_channels(x, local_size, alpha, beta, k)
+        return lrn_fused(x, local_size, alpha, beta, k, layout=layout)
+    return lrn_across_channels(x, local_size, alpha, beta, k, layout)

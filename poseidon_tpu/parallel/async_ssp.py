@@ -65,14 +65,23 @@ routinely, so this tier survives them instead):
   drops oplogs behind a dead thread.
 
 Wire format: length-prefixed pickles of numpy pytrees over TCP on the
-launcher's control network (trusted, same trust domain as
-jax.distributed's own channel). A malformed or truncated frame never kills
+launcher's control network. A malformed or truncated frame never kills
 the service: the offending connection is logged and dropped
 (:class:`FrameError`), everyone else keeps training.
+
+Security: the payloads are PICKLES — arbitrary code execution for anyone
+who can complete a connection — so (a) the service binds to 127.0.0.1
+unless a host is explicitly passed (the launcher's coordinator address is
+such an explicit override), and (b) when a shared secret is configured
+(``POSEIDON_ASYNC_TOKEN`` in the launcher env, or the ``auth_token``
+argument), every connection must pass an HMAC-SHA256 challenge/response
+(``proto/wire.py``) over raw bytes BEFORE the first pickle frame is ever
+parsed; a bad token gets the connection closed, never deserialized.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import random
 import socket
@@ -82,11 +91,21 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..proto.wire import (FrameError, recv_frame as _recv_msg,
-                          send_frame as _send_msg)
+from ..proto.wire import (AuthError, FrameError, client_handshake,
+                          recv_frame as _recv_msg, send_frame as _send_msg,
+                          server_handshake)
 
 __all__ = ["ParamService", "AsyncSSPClient", "run_async_ssp_worker",
-           "FrameError"]
+           "FrameError", "AuthError"]
+
+AUTH_TOKEN_ENV = "POSEIDON_ASYNC_TOKEN"
+
+
+def _env_auth_token(explicit: Optional[str]) -> Optional[str]:
+    """Resolve the shared secret: explicit argument wins, else the
+    launcher env; empty string means disabled either way."""
+    tok = explicit if explicit is not None else os.environ.get(AUTH_TOKEN_ENV)
+    return tok or None
 
 
 def _log(msg: str) -> None:
@@ -171,9 +190,15 @@ class ParamService:
     def __init__(self, params: Dict, n_workers: int,
                  host: str = "127.0.0.1", port: int = 0,
                  server_logic: str = "inc", init_step: float = 0.1,
-                 liveness_timeout_s: Optional[float] = None):
+                 liveness_timeout_s: Optional[float] = None,
+                 auth_token: Optional[str] = None):
         if server_logic not in ("inc", "adarevision"):
             raise ValueError(f"unknown server_logic {server_logic!r}")
+        # default bind is LOOPBACK-ONLY (host="127.0.0.1"); a wider bind is
+        # an explicit caller decision (e.g. the launcher's coordinator
+        # host) and should come with an auth token — the frames are pickles
+        self.auth_token = _env_auth_token(auth_token)
+        self.auth_failures = 0  # rejected handshakes (telemetry)
         self.anchor = _tree_copy(params)
         self.server_logic = server_logic
         self.init_step = init_step
@@ -277,6 +302,16 @@ class ParamService:
                      f"(clock {self.clocks.get(worker, -1)})")
 
     def _serve(self, conn: socket.socket) -> None:
+        if self.auth_token is not None:
+            # authenticate BEFORE any frame parse: recv_frame unpickles,
+            # and unauthenticated bytes must never reach a pickle loader
+            if not server_handshake(conn, self.auth_token):
+                with self._lock:
+                    self.auth_failures += 1
+                _log("ParamService: rejecting unauthenticated connection "
+                     "(bad or missing token)")
+                conn.close()
+                return
         worker: Optional[int] = None
         registered = False
         abnormal = False
@@ -458,8 +493,10 @@ class AsyncSSPClient:
                  heartbeat_s: Optional[float] = None,
                  reconnect_deadline_s: Optional[float] = None,
                  backoff_base_s: Optional[float] = None,
-                 backoff_cap_s: Optional[float] = None):
+                 backoff_cap_s: Optional[float] = None,
+                 auth_token: Optional[str] = None):
         self.worker = worker
+        self.auth_token = _env_auth_token(auth_token)
         self.n_workers = n_workers if n_workers else worker + 1
         self.staleness = staleness
         self.server_logic = server_logic
@@ -501,6 +538,12 @@ class AsyncSSPClient:
         from an evicted worker is its rejoin signal."""
         sk = socket.create_connection(self._addr, timeout=5.0)
         try:
+            if self.auth_token is not None:
+                # answer the service's HMAC challenge before the first
+                # frame; a wrong token gets the socket closed server-side
+                # and surfaces here as a dead channel (dial retries, then
+                # the rendezvous deadline raises)
+                client_handshake(sk, self.auth_token)
             _send_msg(sk, {"kind": "hello", "worker": self.worker})
             _recv_msg(sk)
         except BaseException:

@@ -416,6 +416,10 @@ class CommContext:
         self._bucket_bytes = 0.0
 
     def tap_param(self, layer: str, pname: str, w: jax.Array) -> jax.Array:
+        # LAYOUT CONTRACT: ``w`` is always the CANONICAL parameter (OIHW
+        # conv weights, (M, K=C*H*W) FC weights) — the layout plan presents
+        # weights to NHWC convs via dimension numbers, never a reshaped
+        # copy, so the cotangent psummed here is canonical under any plan.
         strat = self.cfg.strategy_for(layer)
         if strat in (LOCAL, TOPK, DENSE_FUSED):
             # LOCAL: never synced. TOPK: the trainer compresses + psums the
@@ -448,6 +452,13 @@ class CommContext:
         return w_out
 
     def inner_product(self, layer: str, x, w, b) -> Optional[jax.Array]:
+        """SFB entry point. LAYOUT CONTRACT: ``x`` arrives in canonical
+        NCHW (the net-level layout plan converts at the FC boundary before
+        this call — core/net.py), so the flattened bottom factor's K
+        ordering always matches the canonical (M, C*H*W) weight. The
+        all-gathered sufficient factors and the reconstructed global ∇W
+        are therefore layout-portable: a checkpoint written by an NHWC run
+        carries the exact same factor/gradient layout as an NCHW run."""
         if self.cfg.strategy_for(layer) != SFB:
             return None
         axes = self.cfg.sync_axes

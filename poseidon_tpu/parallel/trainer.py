@@ -100,6 +100,10 @@ class TrainStep:
     # optimizer step (SolverParameter.iter_size); batches carry a leading
     # [K] micro-batch axis (inside the scan axis, when both are set).
     iter_size: Optional[int] = None
+    # Physical layout the step expects 4-D image inputs in ("NCHW" default;
+    # "NHWC" when the caller feeds channels-last directly so an NHWC-planned
+    # net's hot path carries zero entry transposes — see core/net.py).
+    input_layout: str = "NCHW"
 
 
 def comm_error_groups(comm: Optional[CommConfig], mesh: Mesh) -> int:
@@ -124,8 +128,16 @@ def build_train_step(
     scan_reuse_batch: bool = False,
     input_transform: Optional[Callable] = None,
     iter_size: int = 1,
+    input_layout: str = "NCHW",
 ) -> TrainStep:
     """Compiled SPMD train step over ``mesh``.
+
+    ``input_layout="NHWC"`` declares that the caller feeds 4-D image blobs
+    channels-last (after any ``input_transform``, which runs first); with
+    an NHWC-planned net this removes the per-step entry transpose — the
+    data plane ships HWC-native images as-is. Default "NCHW" keeps the
+    Caffe feeding contract and costs one in-graph entry transpose per
+    image input under an NHWC plan.
 
     With ``comm.dcn_axis`` set (two-tier mesh, e.g. axes ("dcn", "data")),
     DENSE/SFB collectives ride both axes jointly, while TOPK layers become
@@ -243,7 +255,8 @@ def build_train_step(
 
                 def micro_loss(p):
                     o = net.apply(p, mb, train=True,
-                                  rng=jax.random.fold_in(rng, i), comm=None)
+                                  rng=jax.random.fold_in(rng, i), comm=None,
+                                  input_layout=input_layout)
                     return o.loss, o
 
                 g, o = jax.grad(micro_loss, has_aux=True)(params)
@@ -274,7 +287,8 @@ def build_train_step(
 
             def loss_fn(p):
                 o = net.apply(p, batch, train=True, rng=rng, comm=ctx,
-                              keep_blobs=bool(dump_blobs))
+                              keep_blobs=bool(dump_blobs),
+                              input_layout=input_layout)
                 return o.loss, o
 
             grads, out = jax.grad(loss_fn, has_aux=True)(params)
@@ -373,6 +387,7 @@ def build_train_step(
             lowerable=jitted,
             scan_steps=scan_steps,
             iter_size=iter_size if iter_size > 1 else None,
+            input_layout=input_layout,
         )
 
     sharded = jax.shard_map(
@@ -395,6 +410,7 @@ def build_train_step(
         replicated=NamedSharding(mesh, P()),
         lowerable=jitted,
         iter_size=iter_size if iter_size > 1 else None,
+        input_layout=input_layout,
     )
 
 

@@ -92,6 +92,99 @@ def recv_frame(sock: socket.socket):
         raise FrameError(f"bad frame payload: {type(e).__name__}: {e}") from e
 
 
+# --------------------------------------------------------------------------- #
+# Shared-secret connection handshake. The frame payloads are PICKLES —
+# arbitrary code execution for whoever can reach (or spoof) the port — so
+# an auth-enabled tier authenticates every connection MUTUALLY, over raw
+# bytes (never pickle), before either side's recv_frame parses a thing:
+#
+#   server -> client : MAGIC + nonce_s                 (challenge)
+#   client -> server : HMAC(token, nonce_s) + nonce_c  (proof + challenge)
+#   server -> client : HMAC(token, nonce_c + b"srv")   (proof)
+#
+# The server proves possession too — a spoofed/MITM'd service that only
+# replays the magic cannot produce the second digest, so a worker never
+# feeds bytes from an unauthenticated peer to its pickle loader either.
+# Digests are compared in constant time. The token rides the launcher env
+# (POSEIDON_ASYNC_TOKEN) — same trust distribution as jax.distributed's
+# coordinator address.
+# --------------------------------------------------------------------------- #
+
+AUTH_MAGIC = b"PSDNAUTH"
+AUTH_NONCE_LEN = 16
+AUTH_DIGEST_LEN = 32  # sha256
+_AUTH_SERVER_TAG = b"srv"
+
+
+class AuthError(ConnectionError):
+    """Handshake failed: bad token, wrong protocol bytes, or a peer that
+    speaks frames at an auth-required service."""
+
+
+def _hmac_digest(token: str, nonce: bytes) -> bytes:
+    import hashlib
+    import hmac as hmac_mod
+    return hmac_mod.new(token.encode("utf-8"), nonce,
+                        hashlib.sha256).digest()
+
+
+def server_handshake(sock: socket.socket, token: str,
+                     timeout_s: float = 5.0) -> bool:
+    """Authenticate one inbound connection (and prove our own token back).
+    Returns True on success; False (after which the caller must CLOSE the
+    socket without reading a single frame) on any mismatch, timeout, or
+    protocol violation."""
+    import hmac as hmac_mod
+    nonce = __import__("os").urandom(AUTH_NONCE_LEN)
+    prev = sock.gettimeout()
+    sock.settimeout(timeout_s)
+    try:
+        sock.sendall(AUTH_MAGIC + nonce)
+        got = recv_exact(sock, AUTH_DIGEST_LEN + AUTH_NONCE_LEN)
+        digest, nonce_c = got[:AUTH_DIGEST_LEN], got[AUTH_DIGEST_LEN:]
+        if not hmac_mod.compare_digest(digest, _hmac_digest(token, nonce)):
+            return False
+        sock.sendall(_hmac_digest(token, nonce_c + _AUTH_SERVER_TAG))
+        return True
+    except (OSError, ConnectionError, socket.timeout):
+        return False
+    finally:
+        try:
+            sock.settimeout(prev)
+        except OSError:
+            pass
+
+
+def client_handshake(sock: socket.socket, token: str,
+                     timeout_s: float = 5.0) -> None:
+    """Answer the server's challenge AND verify the server's proof before
+    the caller parses any frame. Raises AuthError on protocol mismatch
+    (e.g. the service runs without a token and sent a frame header
+    instead of the challenge) or on a server that cannot prove the
+    token (spoofed endpoint)."""
+    import hmac as hmac_mod
+    prev = sock.gettimeout()
+    sock.settimeout(timeout_s)
+    try:
+        head = recv_exact(sock, len(AUTH_MAGIC) + AUTH_NONCE_LEN)
+        if not head.startswith(AUTH_MAGIC):
+            raise AuthError("peer did not offer an auth challenge "
+                            "(token configured on one side only?)")
+        nonce_s = head[len(AUTH_MAGIC):]
+        nonce_c = __import__("os").urandom(AUTH_NONCE_LEN)
+        sock.sendall(_hmac_digest(token, nonce_s) + nonce_c)
+        proof = recv_exact(sock, AUTH_DIGEST_LEN)
+        if not hmac_mod.compare_digest(
+                proof, _hmac_digest(token, nonce_c + _AUTH_SERVER_TAG)):
+            raise AuthError("peer failed to prove the shared token "
+                            "(spoofed service?)")
+    finally:
+        try:
+            sock.settimeout(prev)
+        except OSError:
+            pass
+
+
 def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
     result = 0
     shift = 0

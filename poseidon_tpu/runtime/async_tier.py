@@ -48,10 +48,22 @@ class AsyncSSPTier:
                  service_port: Optional[int] = None,
                  heartbeat_s: Optional[float] = None,
                  liveness_timeout_s: Optional[float] = None,
-                 reconnect_deadline_s: Optional[float] = None):
+                 reconnect_deadline_s: Optional[float] = None,
+                 gate_timeout_s: float = 120.0,
+                 first_gate_timeout_s: Optional[float] = None):
         self.rank, self.n_procs, coord = env_world()
         self.staleness = staleness
         self.sync_every = max(1, sync_every)
+        # SSP gate backstop, configurable from the launcher (the client's
+        # hardcoded 120 s default killed healthy runs). The FIRST clock's
+        # gate waits on peers that are still JIT-compiling their train
+        # step — multi-minute for the benchmark nets — so it gets a
+        # generously scaled timeout unless the caller pins one.
+        self.gate_timeout_s = gate_timeout_s
+        self.first_gate_timeout_s = (
+            first_gate_timeout_s if first_gate_timeout_s is not None
+            else max(1800.0, 10.0 * gate_timeout_s))
+        self._gated_once = False
         host = "127.0.0.1"
         port = service_port
         if coord:
@@ -99,21 +111,45 @@ class AsyncSSPTier:
     # ------------------------------------------------------------------ #
     def after_iters(self, engine, n_iters: int) -> None:
         """Called by Engine.train after every completed dispatch (n_iters
-        optimizer steps). Flush + refresh + gate at the clock cadence."""
+        optimizer steps). Flush + refresh + gate at the clock cadence.
+
+        The iteration carry SUBTRACTS ``sync_every`` per flush (loop-flush)
+        instead of resetting to zero: a dispatch covering K > sync_every
+        iterations advances the clock floor((carry + K) / sync_every)
+        times — the first flush carries the whole delta, the rest advance
+        the clock on empty deltas — so ``steps_per_dispatch`` larger than
+        ``async_sync_every`` no longer silently widens the effective
+        staleness window (a clock must always mean sync_every iterations,
+        or the SSP bound s is measured in the wrong unit)."""
         self._iters_since += n_iters
         if self._iters_since < self.sync_every:
             return
-        self._iters_since = 0
         cur = _to_host(engine.params)
         delta = {l: {p: cur[l][p] - self._prev[l][p] for p in ps}
                  for l, ps in cur.items()}
         clock = self.client.push(delta)
+        # exception safety, not data flow: refresh() below replaces _prev,
+        # but if it raises (permanently dead tier) a retrying caller must
+        # never re-derive — and double-push — the delta just enqueued
+        self._prev = cur
+        self._iters_since -= self.sync_every
+        while self._iters_since >= self.sync_every:
+            # the remaining windows' updates are already in the first
+            # flush; advance the clock on EMPTY deltas (the service's
+            # apply iterates the payload's keys, so {} is a pure clock
+            # tick — no parameter-sized zero trees on the wire or in the
+            # client's replay oplog)
+            clock = self.client.push({})
+            self._iters_since -= self.sync_every
         cache, _ = self.client.refresh()
         self._prev = cache
         engine.params = jax.device_put(
             {l: {p: v for p, v in ps.items()} for l, ps in cache.items()},
             engine.train_step.replicated)
-        self.client.gate(clock + 1)
+        timeout = (self.gate_timeout_s if self._gated_once
+                   else self.first_gate_timeout_s)
+        self._gated_once = True
+        self.client.gate(clock + 1, timeout_s=timeout)
 
     def finish(self, engine) -> Dict[str, float]:
         # flush the residual delta of any iterations past the last

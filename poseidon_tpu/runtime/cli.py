@@ -79,7 +79,10 @@ def _engine_from_args(args, phase_nets=True):
                           ("liveness_timeout_s",
                            "async_liveness_timeout_s"),
                           ("reconnect_deadline_s",
-                           "async_reconnect_deadline_s")):
+                           "async_reconnect_deadline_s"),
+                          ("gate_timeout_s", "async_gate_timeout_s"),
+                          ("first_gate_timeout_s",
+                           "async_first_gate_timeout_s")):
             v = getattr(args, flag, -1.0)
             if v is not None and v >= 0:
                 async_cfg[key] = v
@@ -672,6 +675,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="async-SSP client gives up reconnecting (and "
                         "surfaces permanent failure to the training loop) "
                         "after this long; negative = FaultConfig default")
+    t.add_argument("--async_gate_timeout_s", type=float, default=-1.0,
+                   help="async-SSP read-gate backstop per clock; negative "
+                        "= tier default (120 s)")
+    t.add_argument("--async_first_gate_timeout_s", type=float, default=-1.0,
+                   help="async-SSP FIRST-clock gate backstop (covers "
+                        "peers' initial multi-minute JIT compile); "
+                        "negative = max(1800 s, 10x gate timeout)")
     t.add_argument("--hostfile", default="",
                    help="cluster hostfile ('<id> <ip> <port>' lines)")
     t.add_argument("--node_id", type=int, default=-1,

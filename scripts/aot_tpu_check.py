@@ -184,6 +184,17 @@ def section_pallas_mosaic(topo) -> dict:
     check("lrn_fused_bwd",
           lambda x: jax.grad(lambda y: lrn_fused(
               y, 5, 1e-4, 0.75, 1.0, interpret=False).sum())(x), x)
+    # the channels-last kernel entry (net-level NHWC plan): channels ride
+    # the block's MINOR axis — a different Mosaic tiling than the NCHW
+    # entry, so it needs its own lowering gate
+    xh = aval((8, 27, 27, 96), jnp.float32)
+    check("lrn_fused_nhwc_fwd",
+          lambda x: lrn_fused(x, 5, 1e-4, 0.75, 1.0, interpret=False,
+                              layout="NHWC"), xh)
+    check("lrn_fused_nhwc_bwd",
+          lambda x: jax.grad(lambda y: lrn_fused(
+              y, 5, 1e-4, 0.75, 1.0, interpret=False,
+              layout="NHWC").sum())(x), xh)
 
     n_fail = sum(1 for c in cases.values() if not c["ok"])
     return {"cases": cases, "n_cases": len(cases), "n_fail": n_fail,
@@ -378,32 +389,59 @@ def section_nhwc(topo) -> dict:
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from poseidon_tpu import config
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
     from poseidon_tpu.ops import nn
+    from poseidon_tpu.runtime import hlo_layout as HL
 
     m1 = _mesh(topo, ("x",), (1,))
     sh = NamedSharding(m1, P())
     B, C, H, W, C1, C2 = 8, 3, 63, 63, 32, 64
-    avals = [jax.ShapeDtypeStruct(s, jnp.float32, sharding=sh)
-             for s in ((B, C, H, W), (C1, C, 3, 3), (C1,),
-                       (C2, C1, 3, 3), (C2,))]
 
-    def chain(x, w1, b1, w2, b2):
-        y = nn.conv2d(x, w1, b1, stride=(2, 2), pad=(1, 1))
-        y = jax.nn.relu(y)
-        y = nn.lrn_across_channels(y, 5, 1e-4, 0.75)
-        y = nn.max_pool(y, (3, 3), (2, 2), (0, 0))
-        return nn.conv2d(y, w2, b2, stride=(1, 1), pad=(1, 1))
+    def avals(layout):
+        xs = (B, H, W, C) if layout == "NHWC" else (B, C, H, W)
+        return [jax.ShapeDtypeStruct(s, jnp.float32, sharding=sh)
+                for s in (xs, (C1, C, 3, 3), (C1,), (C2, C1, 3, 3), (C2,))]
+
+    def chain(layout):
+        # ops take the layout explicitly now (net-level plan, round 6):
+        # the NHWC chain is NATIVE channels-last — weights stay OIHW
+        def f(x, w1, b1, w2, b2):
+            y = nn.conv2d(x, w1, b1, stride=(2, 2), pad=(1, 1),
+                          layout=layout, act="relu")
+            y = nn.lrn_across_channels(y, 5, 1e-4, 0.75, layout=layout)
+            y = nn.max_pool(y, (3, 3), (2, 2), (0, 0), layout=layout)
+            return nn.conv2d(y, w2, b2, stride=(1, 1), pad=(1, 1),
+                             layout=layout)
+        return f
 
     out = {}
     for layout in ("NCHW", "NHWC"):
-        with config.policy_scope(conv_layout=layout):
-            txt = _compile(chain, *avals)
+        txt = _compile(chain(layout), *avals(layout))
         out[f"{layout.lower()}_transposes"] = len(
             re.findall(r"= [a-z0-9\[\]{},]+ transpose\(", txt))
         out[f"{layout.lower()}_copies"] = txt.count(" copy(")
     out["boundary_transposes_cancel"] = (
         out["nhwc_transposes"] <= out["nchw_transposes"] + 2)
+
+    # net-level acceptance check: the FULL AlexNet/GoogLeNet optimizer
+    # step, AOT-compiled for the abstract v5e — layout transposes must sit
+    # only at the genuine FC boundaries (2 per IP flatten of a non-
+    # degenerate spatial blob), never inside the conv/pool/LRN chain
+    for model, img, bs in (("alexnet", 227, 8), ("googlenet", 224, 4)):
+        np_ = getattr(zoo, model)(num_classes=1000, with_accuracy=False)
+        shapes = {"data": (bs, 3, img, img), "label": (bs,)}
+        for layout in ("NCHW", "NHWC"):
+            net = Net(np_, "TRAIN", shapes, conv_layout=layout)
+            rep = HL.net_transpose_report(net, per_dev_batch=bs, image=img,
+                                          optimized=True, sharding=sh)
+            out[f"{model}_{layout.lower()}_layout_transposes"] = \
+                rep["layout_transposes"]
+            if layout == "NHWC":
+                out[f"{model}_nhwc_transpose_shapes"] = \
+                    rep["layout_transpose_shapes"]
+    out["alexnet_chain_clean"] = out.get(
+        "alexnet_nhwc_layout_transposes", 99) <= 2
     return out
 
 
@@ -705,10 +743,15 @@ def section_cnn_configs(topo) -> dict:
                 sp = SolverParameter(base_lr=0.01, lr_policy="fixed",
                                      momentum=0.9)
                 comm = CommConfig()
-                ts = build_train_step(net, sp, mesh, comm, donate=False)
+                # feed the planned layout directly (net-level plan): the
+                # NHWC configs are benched transpose-free end to end
+                ts = build_train_step(net, sp, mesh, comm, donate=False,
+                                      input_layout=layout)
                 params = net.init(jax.random.PRNGKey(0))
                 state = init_train_state(params, comm, 1)
-                feed = {"data": jnp.zeros((256, 3, 227, 227), jnp.float32),
+                dshape = ((256, 227, 227, 3) if layout == "NHWC"
+                          else (256, 3, 227, 227))
+                feed = {"data": jnp.zeros(dshape, jnp.float32),
                         "label": jnp.zeros((256,), jnp.int32)}
                 txt = (ts.lowerable or ts.step).lower(
                     params, state, feed,

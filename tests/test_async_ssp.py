@@ -510,3 +510,134 @@ def test_adarevision_digits_converges():
         svc.close()
     assert spread <= 3
     assert acc > 0.92, acc
+
+
+# --------------------------------------------------------------------------- #
+# connection authentication (round 6: pickle frames need a gate)
+# --------------------------------------------------------------------------- #
+
+def test_auth_rejects_bad_token_before_any_frame():
+    """A connection with the wrong shared secret is closed at the
+    handshake: no pickle frame from it is ever parsed (the service's
+    frame counters stay untouched), and auth_failures records it."""
+    import struct
+
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, auth_token="s3cret")
+    try:
+        sk = socket.create_connection(("127.0.0.1", svc.port), timeout=5.0)
+        sk.settimeout(5.0)
+        # read the challenge, answer garbage of the right length
+        from poseidon_tpu.proto.wire import AUTH_MAGIC, AUTH_NONCE_LEN
+        head = sk.recv(len(AUTH_MAGIC) + AUTH_NONCE_LEN)
+        assert head.startswith(AUTH_MAGIC)
+        sk.sendall(b"\x00" * (32 + AUTH_NONCE_LEN))  # bad digest + nonce
+        # server must close without ever reading a frame; a subsequent
+        # huge "frame" we send goes nowhere
+        try:
+            sk.sendall(struct.pack("!Q", 1 << 40) + b"boom")
+        except OSError:
+            pass
+        try:
+            assert sk.recv(1) == b""  # service closed our connection
+        except ConnectionError:
+            pass  # RST instead of FIN — equally closed
+        sk.close()
+        deadline = __import__("time").time() + 5.0
+        while svc.auth_failures == 0 and __import__("time").time() < deadline:
+            __import__("time").sleep(0.01)
+        assert svc.auth_failures == 1
+        assert svc.bad_frames == 0       # nothing ever reached the parser
+        assert svc.clocks == {0: -1}     # and no state changed
+    finally:
+        svc.close()
+
+
+def test_auth_good_token_trains_end_to_end():
+    """With matching tokens on both sides the full worker protocol runs
+    unchanged (handshake is transparent to the frame layer)."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=2, auth_token="tok123")
+    try:
+        _run_workers(2, 10, 3, {}, svc, params,
+                     client_opts={"auth_token": "tok123"})
+        np.testing.assert_allclose(svc.anchor["fc"]["w"], 6.0)
+    finally:
+        svc.close()
+
+
+def test_auth_wrong_client_token_fails_rendezvous():
+    """A client dialing with the WRONG token never gets a connection: the
+    rendezvous deadline surfaces the failure instead of silently feeding
+    frames to a service that drops them."""
+    from poseidon_tpu.parallel.async_ssp import AsyncSSPClient
+
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1, auth_token="right")
+    try:
+        with pytest.raises((OSError, EOFError, ConnectionError)):
+            AsyncSSPClient(0, ("127.0.0.1", svc.port), staleness=0,
+                           n_workers=1, retry_s=0.8, auth_token="wrong")
+        assert svc.auth_failures >= 1
+    finally:
+        svc.close()
+
+
+def test_auth_token_from_launcher_env(monkeypatch):
+    """The launcher distributes the secret via POSEIDON_ASYNC_TOKEN; both
+    sides pick it up with no explicit plumbing."""
+    monkeypatch.setenv("POSEIDON_ASYNC_TOKEN", "envtok")
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1)
+    assert svc.auth_token == "envtok"
+    try:
+        _run_workers(1, 5, 2, {}, svc, params)
+        np.testing.assert_allclose(svc.anchor["fc"]["w"], 2.0)
+    finally:
+        svc.close()
+
+
+def test_default_bind_is_loopback():
+    """Unless a host is explicitly passed, the service listens on
+    127.0.0.1 only — pickle frames are never reachable from off-host."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=1)
+    try:
+        assert svc._srv.getsockname()[0] == "127.0.0.1"
+    finally:
+        svc.close()
+
+
+def test_auth_client_rejects_spoofed_service():
+    """Mutual handshake: a spoofed endpoint that replays the challenge
+    magic but cannot prove the token must be rejected by the CLIENT before
+    it parses a single frame (pickle loaders on workers are as dangerous
+    as on the service)."""
+    import threading as _threading
+
+    from poseidon_tpu.proto.wire import (AUTH_DIGEST_LEN, AUTH_MAGIC,
+                                         AUTH_NONCE_LEN, AuthError,
+                                         client_handshake)
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def spoof():
+        conn, _ = srv.accept()
+        conn.sendall(AUTH_MAGIC + b"\x11" * AUTH_NONCE_LEN)
+        try:
+            conn.recv(AUTH_DIGEST_LEN + AUTH_NONCE_LEN)
+            conn.sendall(b"\x00" * AUTH_DIGEST_LEN)  # cannot prove token
+        except OSError:
+            pass
+
+    t = _threading.Thread(target=spoof, daemon=True)
+    t.start()
+    sk = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    try:
+        with pytest.raises(AuthError, match="prove"):
+            client_handshake(sk, "the-real-token")
+    finally:
+        sk.close()
+        srv.close()
+        t.join(timeout=5)

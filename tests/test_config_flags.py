@@ -1,0 +1,86 @@
+"""enable_tpu_async_collectives: per-flag honoring of LIBTPU_INIT_ARGS.
+
+Advisor finding (config.py:62, round 6): the old guard only looked for the
+FUSION flag substring — a user who set ``--xla_enable_async_all_reduce=
+false`` (but not the fusion flag) got BOTH flags appended, handing libtpu
+a conflicting duplicate of their explicit choice. Each flag must be
+checked independently: explicit values are honored in either polarity and
+never duplicated; any explicit =false marks a deliberate baseline run and
+nothing is appended at all.
+"""
+
+import re
+
+import pytest
+
+from poseidon_tpu.config import (_ASYNC_COLLECTIVE_FLAGS, _flag_value,
+                                 enable_tpu_async_collectives)
+
+FUSE, ASYNC = _ASYNC_COLLECTIVE_FLAGS
+
+
+def _count(args: str, name: str) -> int:
+    return len(re.findall(r"--%s=" % re.escape(name), args))
+
+
+CASES = [
+    # (existing LIBTPU_INIT_ARGS, expected return,
+    #  expected fuse value, expected async value)
+    ("", True, True, True),
+    (f"--{FUSE}=true", True, True, True),
+    (f"--{ASYNC}=true", True, True, True),
+    (f"--{FUSE}=true --{ASYNC}=true", True, True, True),
+    # the advisor's exact case: explicit async=false must NOT gain a
+    # conflicting duplicate (old code appended both flags here)
+    (f"--{ASYNC}=false", False, None, False),
+    (f"--{FUSE}=false", False, False, None),
+    (f"--{FUSE}=false --{ASYNC}=false", False, False, False),
+    (f"--{FUSE}=true --{ASYNC}=false", False, True, False),
+    # unrelated flags ride along untouched
+    (f"--xla_tpu_foo=7 --{ASYNC}=false", False, None, False),
+    ("--xla_tpu_foo=7", True, True, True),
+]
+
+
+@pytest.mark.parametrize("existing,expect_ret,expect_fuse,expect_async",
+                         CASES)
+def test_async_collective_flag_merge(monkeypatch, existing, expect_ret,
+                                     expect_fuse, expect_async):
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", existing)
+    ret = enable_tpu_async_collectives(check_backend=False)
+    assert ret is expect_ret
+    after = __import__("os").environ["LIBTPU_INIT_ARGS"]
+    for name, expect in ((FUSE, expect_fuse), (ASYNC, expect_async)):
+        # NEVER a duplicate — the satellite's contract
+        assert _count(after, name) <= 1, after
+        assert _flag_value(after, name) is expect, (name, after)
+    # pre-existing unrelated args survive verbatim
+    for tok in existing.split():
+        assert tok in after
+
+
+def test_explicit_false_leaves_env_untouched(monkeypatch):
+    existing = f"--{ASYNC}=false --xla_tpu_bar=1"
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", existing)
+    assert enable_tpu_async_collectives(check_backend=False) is False
+    assert __import__("os").environ["LIBTPU_INIT_ARGS"] == existing
+
+
+def test_flag_value_last_occurrence_wins():
+    args = f"--{ASYNC}=false --{ASYNC}=true"
+    assert _flag_value(args, ASYNC) is True
+    assert _flag_value(args, FUSE) is None
+    assert _flag_value(f"--{ASYNC}=1", ASYNC) is True
+    assert _flag_value(f"--{ASYNC}=0", ASYNC) is False
+
+
+def test_backend_guard_blocks_late_append(monkeypatch):
+    """With jax's backend already initialized (true in this test process),
+    the default call must refuse to mutate LIBTPU_INIT_ARGS when it would
+    need to append."""
+    import jax
+
+    jax.devices()  # force backend init
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", "")
+    assert enable_tpu_async_collectives() is False
+    assert __import__("os").environ["LIBTPU_INIT_ARGS"] == ""

@@ -602,3 +602,142 @@ def test_chaos_kill_one_of_three_mid_run_then_rejoin():
     finally:
         proxy.close()
         svc.close()
+
+
+# --------------------------------------------------------------------------- #
+# round-6 advisor findings: flush cadence + SSP gate timeouts
+# --------------------------------------------------------------------------- #
+
+def _tier_engine(params):
+    import types
+
+    eng = types.SimpleNamespace()
+    eng.params = params
+    eng.train_step = types.SimpleNamespace(replicated=None)
+    return eng
+
+
+def _free_port():
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_after_iters_loop_flush_cadence(monkeypatch):
+    from poseidon_tpu.runtime.async_tier import AsyncSSPTier
+
+    monkeypatch.setenv("POSEIDON_PROC_ID", "0")
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "1")
+    monkeypatch.delenv("POSEIDON_COORDINATOR", raising=False)
+    params = _zeros_params()
+    tier = AsyncSSPTier(params, staleness=10, sync_every=2,
+                        service_port=_free_port())
+    try:
+        eng = _tier_engine({l: {p: np.asarray(v) + 1.0
+                                for p, v in ps.items()}
+                            for l, ps in tier.resume_cache.items()})
+        # 5 iterations at sync_every=2 -> exactly 2 clocks, carry 1
+        tier.after_iters(eng, 5)
+        tier.client._drain()
+        assert tier.client.clock == 1
+        assert tier._iters_since == 1
+        # the anchor saw the whole delta ONCE (second flush was empty)
+        np.testing.assert_allclose(tier.service.anchor["fc"]["w"], 1.0)
+        # one more iteration completes the next window -> clock 2
+        tier.after_iters(eng, 1)
+        tier.client._drain()
+        assert tier.client.clock == 2
+        assert tier._iters_since == 0
+        # sub-window dispatches accumulate without flushing
+        tier.after_iters(eng, 1)
+        assert tier.client.clock == 2
+        assert tier._iters_since == 1
+        tier.finish(eng)
+    finally:
+        if tier.service is not None:
+            tier.service.close()
+
+
+def test_first_clock_gate_survives_slow_compiling_peer(monkeypatch):
+    """Satellite (runtime/async_tier.py:92): a peer still JIT-compiling
+    its step at clock 0 (multi-minute in production) must not
+    TimeoutError-kill a healthy run — the FIRST gate is generously
+    scaled; later gates use the configured backstop."""
+    import threading
+    import time as _time
+
+    from poseidon_tpu.parallel.async_ssp import AsyncSSPClient
+    from poseidon_tpu.runtime.async_tier import AsyncSSPTier
+
+    monkeypatch.setenv("POSEIDON_PROC_ID", "0")
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "2")
+    monkeypatch.delenv("POSEIDON_COORDINATOR", raising=False)
+    params = _zeros_params()
+    # gate_timeout far below the peer's "compile time"; first-gate scaled
+    tier = AsyncSSPTier(params, staleness=0, sync_every=1,
+                        service_port=_free_port(),
+                        gate_timeout_s=0.4, first_gate_timeout_s=30.0)
+    try:
+        peer_err = []
+
+        def slow_peer():
+            try:
+                cli = AsyncSSPClient(1, ("127.0.0.1", tier.client._addr[1]),
+                                     staleness=0, n_workers=2)
+                _time.sleep(1.5)  # "initial JIT compile"
+                cli.push({l: {p: np.zeros_like(v) for p, v in ps.items()}
+                          for l, ps in params.items()})
+                cli._drain()
+                _time.sleep(3.0)  # never reaches clock 1 in this test
+                cli.close()
+            except Exception as e:  # noqa: BLE001
+                peer_err.append(e)
+
+        t = threading.Thread(target=slow_peer, daemon=True)
+        t.start()
+        eng = _tier_engine(dict(tier.resume_cache))
+        t0 = _time.time()
+        tier.after_iters(eng, 1)  # gate(1) needs peer clock >= 0
+        waited = _time.time() - t0
+        assert waited >= 1.0, "gate should have blocked on the slow peer"
+        assert tier._gated_once
+        # the SECOND gate runs at the configured 0.4 s backstop: with the
+        # peer never reaching clock 1, it must fail FAST (not 120 s)
+        t0 = _time.time()
+        with pytest.raises(TimeoutError):
+            tier.after_iters(eng, 1)
+        assert _time.time() - t0 < 10.0
+        t.join(timeout=10)
+        assert not peer_err, peer_err
+    finally:
+        tier.client._stop.set()
+        if tier.service is not None:
+            tier.service.close()
+
+
+def test_first_gate_timeout_default_scales_generously(monkeypatch):
+    from poseidon_tpu.runtime.async_tier import AsyncSSPTier
+
+    monkeypatch.setenv("POSEIDON_PROC_ID", "0")
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "1")
+    monkeypatch.delenv("POSEIDON_COORDINATOR", raising=False)
+    params = _zeros_params()
+    tier = AsyncSSPTier(params, staleness=0, gate_timeout_s=120.0,
+                        service_port=_free_port())
+    try:
+        assert tier.first_gate_timeout_s >= 1800.0
+    finally:
+        tier.client._stop.set()
+        tier.service.close()
+    tier2 = AsyncSSPTier(params, staleness=0, gate_timeout_s=600.0,
+                         service_port=_free_port())
+    try:
+        assert tier2.first_gate_timeout_s >= 6000.0
+        assert tier2.gate_timeout_s == 600.0
+    finally:
+        tier2.client._stop.set()
+        tier2.service.close()

@@ -43,27 +43,41 @@ def test_conv_matches_caffe(rng_np, group):
 
 @pytest.mark.parametrize("group", [1, 2])
 def test_conv_nhwc_layout_matches_nchw(rng_np, group):
-    """Internal NHWC (TPU-preferred) layout: same interface, same numbers,
-    forward and backward."""
+    """Native NHWC (TPU-preferred) conv: channels-last activations with
+    the SAME canonical OIHW weight, same numbers, forward and backward
+    (the net-level layout plan's per-op contract)."""
     import jax
-    from poseidon_tpu.config import policy_scope
     x = rng_np.randn(2, 4, 9, 9).astype(np.float32)
+    xt = np.transpose(x, (0, 2, 3, 1)).copy()
     w = rng_np.randn(6, 4 // group, 3, 3).astype(np.float32)
     b = rng_np.randn(6).astype(np.float32)
 
-    def loss(args, *, _g=group):
+    def loss_nchw(args, *, _g=group):
         xx, ww, bb = args
         return NN.conv2d(xx, ww, bb, (2, 2), (1, 1), _g).sum()
 
+    def loss_nhwc(args, *, _g=group):
+        xx, ww, bb = args
+        return NN.conv2d(xx, ww, bb, (2, 2), (1, 1), _g,
+                         layout="NHWC").sum()
+
     y1 = np.asarray(NN.conv2d(x, w, b, (2, 2), (1, 1), group))
-    g1 = jax.grad(loss)((x, w, b))
-    with policy_scope(conv_layout="NHWC"):
-        y2 = np.asarray(NN.conv2d(x, w, b, (2, 2), (1, 1), group))
-        g2 = jax.grad(loss)((x, w, b))
-    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-5)
-    for a1, a2, name in zip(g1, g2, "xwb"):
-        np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
-                                   rtol=1e-4, atol=1e-5, err_msg=name)
+    g1 = jax.grad(loss_nchw)((x, w, b))
+    y2 = np.asarray(NN.conv2d(xt, w, b, (2, 2), (1, 1), group,
+                              layout="NHWC"))
+    g2 = jax.grad(loss_nhwc)((xt, w, b))
+    np.testing.assert_allclose(y1, np.transpose(y2, (0, 3, 1, 2)),
+                               rtol=1e-5, atol=1e-5)
+    gx1, gw1, gb1 = g1
+    gx2, gw2, gb2 = g2
+    np.testing.assert_allclose(np.asarray(gx1),
+                               np.transpose(np.asarray(gx2), (0, 3, 1, 2)),
+                               rtol=1e-4, atol=1e-5, err_msg="x")
+    # weight/bias grads are CANONICAL in either layout — the whole point
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                               rtol=1e-4, atol=1e-5, err_msg="w")
+    np.testing.assert_allclose(np.asarray(gb1), np.asarray(gb2),
+                               rtol=1e-4, atol=1e-5, err_msg="b")
 
 
 def test_lrn_across_channels(rng_np):
@@ -74,38 +88,42 @@ def test_lrn_across_channels(rng_np):
 
 
 def test_pool_lrn_nhwc_layout_matches_nchw(rng_np):
-    """Channels-last pooling/LRN (round-4: the whole conv->lrn->pool chain
-    runs NHWC under the policy, so boundary transposes cancel — round 3
-    left pool/LRN NCHW and every transpose survived, the 1.9x anomaly):
-    identical numbers either way, forward and backward."""
+    """Native channels-last pooling/LRN/stochastic-pool (the net-level
+    NHWC plan runs these with zero boundary transposes — round 3's per-op
+    shim left pool/LRN NCHW and every transpose survived, the 1.9x
+    anomaly): identical numbers either way, forward and backward."""
     import jax
-    from poseidon_tpu.config import policy_scope
     x = rng_np.randn(2, 8, 9, 9).astype(np.float32)
+    xt = np.transpose(x, (0, 2, 3, 1)).copy()
+    xpos = np.abs(x) + 0.1
+    xpos_t = np.transpose(xpos, (0, 2, 3, 1)).copy()
 
-    def run():
-        outs = {
-            "max": NN.max_pool(x, (3, 3), (2, 2), (1, 1)),
-            "ave": NN.ave_pool(x, (3, 3), (2, 2), (1, 1)),
-            "lrn": NN.lrn_across_channels(x, 5, 1e-4, 0.75),
-            "lrn_w": NN.lrn_within_channel(x, 3, 1e-4, 0.75),
-        }
-        grads = {
-            k: jax.grad(lambda xx, _f=f: _f(xx).sum())(x)
-            for k, f in {
-                "max": lambda xx: NN.max_pool(xx, (3, 3), (2, 2), (1, 1)),
-                "lrn": lambda xx: NN.lrn_across_channels(xx, 5, 1e-4, 0.75),
-            }.items()}
-        return outs, grads
-
-    o1, g1 = run()
-    with policy_scope(conv_layout="NHWC"):
-        o2, g2 = run()
-    for k in o1:
-        np.testing.assert_allclose(np.asarray(o1[k]), np.asarray(o2[k]),
+    fns = {
+        "max": lambda a, lay: NN.max_pool(a, (3, 3), (2, 2), (1, 1), lay),
+        "ave": lambda a, lay: NN.ave_pool(a, (3, 3), (2, 2), (1, 1), lay),
+        "lrn": lambda a, lay: NN.lrn_across_channels(a, 5, 1e-4, 0.75,
+                                                     1.0, lay),
+        "lrn_w": lambda a, lay: NN.lrn_within_channel(a, 3, 1e-4, 0.75,
+                                                      lay),
+        "gap": lambda a, lay: NN.global_ave_pool(a, lay),
+    }
+    for k, f in fns.items():
+        o1 = np.asarray(f(x, "NCHW"))
+        o2 = np.asarray(f(xt, "NHWC"))
+        np.testing.assert_allclose(o1, np.transpose(o2, (0, 3, 1, 2)),
                                    rtol=1e-5, atol=1e-6, err_msg=k)
-    for k in g1:
-        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
-                                   rtol=1e-5, atol=1e-6, err_msg=f"grad:{k}")
+    sp1 = np.asarray(NN.stochastic_pool(xpos, (3, 3), (3, 3), (0, 0),
+                                        None, True, "NCHW"))
+    sp2 = np.asarray(NN.stochastic_pool(xpos_t, (3, 3), (3, 3), (0, 0),
+                                        None, True, "NHWC"))
+    np.testing.assert_allclose(sp1, np.transpose(sp2, (0, 3, 1, 2)),
+                               rtol=1e-5, atol=1e-6, err_msg="stochastic")
+    for k in ("max", "lrn"):
+        g1 = jax.grad(lambda a, _f=fns[k]: _f(a, "NCHW").sum())(x)
+        g2 = jax.grad(lambda a, _f=fns[k]: _f(a, "NHWC").sum())(xt)
+        np.testing.assert_allclose(
+            np.asarray(g1), np.transpose(np.asarray(g2), (0, 3, 1, 2)),
+            rtol=1e-5, atol=1e-6, err_msg=f"grad:{k}")
 
 
 def test_lrn_within_channel(rng_np):
@@ -263,8 +281,14 @@ def test_conv_space_to_depth_skips_many_channel_convs(rng_np):
     w8 = jnp.zeros((4, 8, 3, 3))
     w3 = jnp.zeros((4, 3, 3, 3))
     with policy_scope(conv_s2d=True):
-        assert not _s2d_applicable(x8, w8, (2, 2), 1)   # enough lanes
-        assert not _s2d_applicable(x3, w3, (1, 1), 1)   # stride 1
-        assert not _s2d_applicable(x3, w3, (2, 2), 3)   # grouped
-        assert _s2d_applicable(x3, w3, (2, 2), 1)
-    assert not _s2d_applicable(x3, w3, (2, 2), 1)       # knob off
+        assert not _s2d_applicable(x8, w8, (2, 2), 1, "NCHW")  # enough lanes
+        assert not _s2d_applicable(x3, w3, (1, 1), 1, "NCHW")  # stride 1
+        assert not _s2d_applicable(x3, w3, (2, 2), 3, "NCHW")  # grouped
+        assert _s2d_applicable(x3, w3, (2, 2), 1, "NCHW")
+        # NHWC: the channel count is read off the minor axis
+        import jax.numpy as _jnp
+        assert _s2d_applicable(_jnp.zeros((1, 9, 9, 3)), w3, (2, 2), 1,
+                               "NHWC")
+        assert not _s2d_applicable(_jnp.zeros((1, 9, 9, 8)), w8, (2, 2), 1,
+                                   "NHWC")
+    assert not _s2d_applicable(x3, w3, (2, 2), 1, "NCHW")      # knob off

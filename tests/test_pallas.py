@@ -142,3 +142,70 @@ def test_maybe_lrn_fused_routing():
     want = lrn_across_channels(x, 5, 1e-4, 0.75)
     got = maybe_lrn_fused(x, 5, 1e-4, 0.75)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_lrn_fused_nhwc_entry_matches_nchw():
+    """The NHWC kernel entry (net-level channels-last plan): channels on
+    the MINOR axis inside the block, no layout round-trip at the
+    custom-call boundary — same numbers as the NCHW kernel."""
+    rs = np.random.RandomState(6)
+    x = rs.randn(2, 16, 8, 8).astype(np.float32)
+    xt = jnp.asarray(np.transpose(x, (0, 2, 3, 1)).copy())
+    want = np.asarray(lrn_fused(jnp.asarray(x), 5, 1e-4, 0.75))
+    got = np.asarray(lrn_fused(xt, 5, 1e-4, 0.75, layout="NHWC"))
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_fused_bwd_nhwc_matches_analytic():
+    from poseidon_tpu.ops.pallas_kernels import lrn_fused_bwd
+    rs = np.random.RandomState(7)
+    x = rs.randn(2, 16, 6, 6).astype(np.float32)
+    g = rs.randn(2, 16, 6, 6).astype(np.float32)
+    _, vjp = jax.vjp(
+        lambda x_: lrn_across_channels(x_, 5, 1e-4, 0.75), jnp.asarray(x))
+    (want,) = vjp(jnp.asarray(g))
+    got = lrn_fused_bwd(jnp.asarray(np.transpose(x, (0, 2, 3, 1)).copy()),
+                        jnp.asarray(np.transpose(g, (0, 2, 3, 1)).copy()),
+                        5, 1e-4, 0.75, interpret=True, layout="NHWC")
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(got), (0, 3, 1, 2)), np.asarray(want),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_tile_rejects_vmem_busting_channel_counts():
+    """Advisor finding (round 6): at channels > ~2560 the VMEM budget caps
+    the spatial tile below 128 lanes; _lrn_tile must refuse (clear error)
+    instead of emitting a block that exceeds the scoped-VMEM limit at
+    Mosaic compile time."""
+    import pytest as _pytest
+    from poseidon_tpu.ops.pallas_kernels import (LRNTileError, _lrn_tile,
+                                                 lrn_tile_feasible)
+    # comfortably feasible: the AlexNet/GoogLeNet norms
+    assert lrn_tile_feasible(55 * 55, 96)
+    assert lrn_tile_feasible(56 * 56, 192)
+    # the cap boundary: budget/(4*8*128) = 2560 channels
+    assert lrn_tile_feasible(128 * 128, 2560)
+    assert not lrn_tile_feasible(128 * 128, 2561)
+    assert not lrn_tile_feasible(128 * 128, 4096)
+    with _pytest.raises(LRNTileError, match="XLA formulation"):
+        _lrn_tile(128 * 128, 512, 4096)
+
+
+def test_lrn_fused_falls_back_to_xla_above_tile_cap():
+    """lrn_fused at 4096 channels (no legal tile) must silently take the
+    XLA formulation — same numbers, forward and gradient, no Mosaic
+    blowup."""
+    rs = np.random.RandomState(8)
+    # hw must exceed the budget's full-extent fit (hw > ~80 at 4096ch) so
+    # the tiler is actually consulted — and then refuses (cap 80 < 128)
+    x = jnp.asarray(rs.randn(1, 4096, 12, 12).astype(np.float32))
+    want = lrn_across_channels(x, 5, 1e-4, 0.75)
+    got = lrn_fused(x, 5, 1e-4, 0.75)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    g_want = jax.grad(
+        lambda x_: jnp.sum(lrn_across_channels(x_, 5, 1e-4, 0.75) ** 2))(x)
+    g_got = jax.grad(
+        lambda x_: jnp.sum(lrn_fused(x_, 5, 1e-4, 0.75) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=1e-5, atol=1e-6)
