@@ -1,0 +1,33 @@
+"""jax API-surface compatibility shims.
+
+The repo targets the current jax spelling (``jax.shard_map`` with
+``check_vma``, ``pltpu.CompilerParams``); CI pins a known-good jaxlib, but
+developer machines and TPU images stride the rename boundaries. Everything
+version-dependent resolves here, once, so call sites keep the modern
+spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` where it exists; the ``jax.experimental``
+    spelling (whose ``check_rep`` is the old name of ``check_vma``)
+    otherwise."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` across the TPUCompilerParams
+    rename."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)

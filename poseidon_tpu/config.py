@@ -20,7 +20,8 @@ from dataclasses import dataclass
 # Numeric-policy names re-exported from poseidon_tpu.numeric via the
 # module __getattr__ below (PEP 562).
 _NUMERIC_NAMES = frozenset({
-    "Policy", "policy", "set_policy", "policy_scope", "matmul_precision",
+    "Policy", "policy", "set_policy", "set_perf_policy", "policy_scope",
+    "matmul_precision",
 })
 
 

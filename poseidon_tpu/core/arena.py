@@ -1,0 +1,239 @@
+"""Flat parameter arena: packed leaves, static offsets, bucketed ranges.
+
+The reference's server tier (Bösen) stores parameters as contiguous table
+rows precisely so update and transmission costs do not scale with the
+NUMBER of tensors (server_table.cpp rows; SSPAggr ships row ranges). The
+JAX port instead carried GoogLeNet's ~120 small param/grad/momentum leaves
+through the whole step: the update phase compiled to a swarm of tiny fused
+kernels and the data-parallel sync was one collective per leaf (the round-5
+GoogLeNet MFU gap vs 16-leaf AlexNet). This module is the arena that fixes
+both:
+
+- **Offset table** (``ArenaSlot``): every DENSE f32 parameter leaf gets a
+  static ``[offset, offset+size)`` range in one flat f32 buffer. Slot order
+  is the DWBP order — REVERSE forward layer order, i.e. the order gradients
+  materialize during backward — so bucket 0's gradients exist first.
+- **Buckets**: the flat range is cut at exact ``bucket_mb`` element
+  boundaries (leaves may span buckets), so the data-parallel gradient sync
+  is exactly ``ceil(total_bytes / bucket_mb)`` collectives — never more,
+  regardless of how leaf sizes pack (greedy whole-leaf bucketing has no
+  such bound).
+- **Views** (``ArenaLayout.views``): a custom-vjp unpack from per-bucket
+  buffers to the per-leaf tree. Forward is slices+reshapes; backward
+  CONCATENATES each bucket's leaf cotangents, so the flat gradient is
+  assembled bucket-by-bucket as backward proceeds — each bucket's psum
+  depends only on its own leaves' gradients, preserving DWBP overlap.
+- **Multiplier segments**: per-leaf ``lr_mult`` / ``decay_mult`` expand to
+  precomputed arena-resident f32 vectors, so the whole SGD/Nesterov/AdaGrad
+  update runs as ONE fused elementwise pass over the buffer
+  (solvers/updates.make_fused_update_fn) instead of one fusion per leaf.
+
+The arena is an in-step representation only: parameters, solver history and
+checkpoints stay canonical per-leaf at every step boundary (pack/unpack are
+exact copies), so snapshots written before the arena existed round-trip
+bit-identically and ``--param_arena=false`` reads them the same way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Tree = Dict[str, Dict[str, jax.Array]]
+
+
+@dataclass(frozen=True)
+class ArenaSlot:
+    """One parameter leaf's static range within the flat buffer."""
+    layer: str
+    pname: str
+    shape: Tuple[int, ...]
+    offset: int          # element offset within the flat f32 buffer
+    size: int
+    lr_mult: float
+    decay_mult: float
+
+
+class ArenaLayout:
+    """Static offset table + bucket ranges for one Net's arena-eligible
+    leaves. Everything here is computed once (plain Python/numpy); the jax
+    ops it emits at trace time are slices, reshapes and concatenates."""
+
+    def __init__(self, slots: Sequence[ArenaSlot],
+                 bucket_mb: Optional[float]):
+        if not slots:
+            raise ValueError("empty arena")
+        self.slots: Tuple[ArenaSlot, ...] = tuple(slots)
+        self.total = slots[-1].offset + slots[-1].size
+        self.dtype = jnp.float32
+        itemsize = 4
+        if bucket_mb is None or bucket_mb <= 0:
+            # per-leaf buckets (the dwbp_bucket_mb=0 convention)
+            self.bucket_ranges = [(s.offset, s.offset + s.size)
+                                  for s in self.slots]
+        else:
+            b = max(1, int(bucket_mb * 1e6) // itemsize)
+            self.bucket_ranges = [(lo, min(lo + b, self.total))
+                                  for lo in range(0, self.total, b)]
+        self.n_buckets = len(self.bucket_ranges)
+        self.layers: FrozenSet[str] = frozenset(s.layer for s in self.slots)
+        self._index = {(s.layer, s.pname): s for s in self.slots}
+        # slot -> pieces (bucket, global lo, global hi); bucket -> pieces
+        # (slot_idx, global lo, global hi). Buckets cut at exact element
+        # boundaries, so a leaf may contribute pieces to several buckets.
+        self._slot_pieces: List[List[Tuple[int, int, int]]] = []
+        self._bucket_pieces: List[List[Tuple[int, int, int]]] = \
+            [[] for _ in self.bucket_ranges]
+        for si, s in enumerate(self.slots):
+            pieces = []
+            for bi, (blo, bhi) in enumerate(self.bucket_ranges):
+                lo, hi = max(s.offset, blo), min(s.offset + s.size, bhi)
+                if lo < hi:
+                    pieces.append((bi, lo, hi))
+                    self._bucket_pieces[bi].append((si, lo, hi))
+            self._slot_pieces.append(pieces)
+        self._views = None
+
+    # -------------------------------------------------------------- #
+    def total_bytes(self) -> int:
+        return self.total * 4
+
+    def has(self, layer: str, pname: str) -> bool:
+        return (layer, pname) in self._index
+
+    def _leaf(self, tree: Tree, slot: ArenaSlot) -> jax.Array:
+        v = tree[slot.layer][slot.pname]
+        if v.dtype != self.dtype:
+            raise TypeError(
+                f"arena leaf {slot.layer}/{slot.pname} is {v.dtype}, not "
+                f"{self.dtype}; the flat parameter arena is f32-homogeneous "
+                f"(disable with param_arena=False)")
+        return v
+
+    def pack(self, tree: Tree) -> jax.Array:
+        """Per-leaf tree -> flat 1-D buffer, in slot (DWBP) order."""
+        return jnp.concatenate(
+            [self._leaf(tree, s).reshape(-1) for s in self.slots])
+
+    def unpack(self, flat: jax.Array) -> Tree:
+        """Flat buffer -> per-leaf tree (static slices + reshapes)."""
+        out: Tree = {}
+        for s in self.slots:
+            leaf = lax.slice(flat, (s.offset,), (s.offset + s.size,))
+            out.setdefault(s.layer, {})[s.pname] = leaf.reshape(s.shape)
+        return out
+
+    def split_buckets(self, flat: jax.Array) -> Tuple[jax.Array, ...]:
+        return tuple(lax.slice(flat, (lo,), (hi,))
+                     for lo, hi in self.bucket_ranges)
+
+    def join_buckets(self, bufs: Sequence[jax.Array]) -> jax.Array:
+        return bufs[0] if len(bufs) == 1 else jnp.concatenate(list(bufs))
+
+    def pack_buckets(self, tree: Tree) -> Tuple[jax.Array, ...]:
+        return self.split_buckets(self.pack(tree))
+
+    # -------------------------------------------------------------- #
+    def residual(self, tree: Tree) -> Tree:
+        """The leaves NOT in the arena (SFB/TOPK/LOCAL/fused opt-outs)."""
+        out: Tree = {}
+        for lname, lp in tree.items():
+            keep = {k: v for k, v in lp.items() if not self.has(lname, k)}
+            if keep:
+                out[lname] = keep
+        return out
+
+    @staticmethod
+    def merge(a: Tree, b: Tree) -> Tree:
+        """Leaf-level union of two disjoint {layer: {param: leaf}} trees."""
+        out = {k: dict(v) for k, v in a.items()}
+        for lname, lp in b.items():
+            out.setdefault(lname, {}).update(lp)
+        return out
+
+    # -------------------------------------------------------------- #
+    def views(self, *bufs: jax.Array) -> Tree:
+        """Per-bucket buffers -> per-leaf tree, as a custom-vjp pair so the
+        COTANGENT comes back packed: the backward concatenates each
+        bucket's leaf cotangents (one copy, no pad-and-add transpose), and
+        each bucket's gradient depends only on its own leaves — the psum
+        for bucket k can issue as soon as its layers' backward is done."""
+        if self._views is None:
+            layout = self
+
+            def fwd_impl(bufs):
+                out: Tree = {}
+                for s, pieces in zip(layout.slots, layout._slot_pieces):
+                    parts = [lax.slice(bufs[bi],
+                                       (lo - layout.bucket_ranges[bi][0],),
+                                       (hi - layout.bucket_ranges[bi][0],))
+                             for bi, lo, hi in pieces]
+                    leaf = parts[0] if len(parts) == 1 else \
+                        jnp.concatenate(parts)
+                    out.setdefault(s.layer, {})[s.pname] = \
+                        leaf.reshape(s.shape)
+                return out
+
+            @jax.custom_vjp
+            def views_fn(*bufs):
+                return fwd_impl(bufs)
+
+            def views_fwd(*bufs):
+                return fwd_impl(bufs), None
+
+            def views_bwd(_, ct):
+                outs = []
+                for pieces in layout._bucket_pieces:
+                    parts = []
+                    for si, lo, hi in pieces:
+                        s = layout.slots[si]
+                        leaf_ct = ct[s.layer][s.pname].reshape(-1)
+                        parts.append(lax.slice(leaf_ct, (lo - s.offset,),
+                                               (hi - s.offset,)))
+                    outs.append(parts[0] if len(parts) == 1 else
+                                jnp.concatenate(parts))
+                return tuple(outs)
+
+            views_fn.defvjp(views_fwd, views_bwd)
+            self._views = views_fn
+        return self._views(*bufs)
+
+    # -------------------------------------------------------------- #
+    def mult_vectors(self, weight_decay: float):
+        """(lr_mults, local_decays) as f32 numpy vectors over the buffer.
+        Each segment holds exactly the scalars the per-leaf update rule
+        uses: f32(lr_mult) and f32(weight_decay * decay_mult) — the
+        products taken in Python float first, like the per-leaf path, so
+        the fused pass is bit-identical."""
+        lr = np.zeros(self.total, np.float32)
+        dec = np.zeros(self.total, np.float32)
+        for s in self.slots:
+            lr[s.offset:s.offset + s.size] = np.float32(s.lr_mult)
+            dec[s.offset:s.offset + s.size] = np.float32(
+                weight_decay * s.decay_mult)
+        return lr, dec
+
+
+def build_arena(order: Sequence[Tuple[str, object]],
+                include: FrozenSet[str],
+                bucket_mb: Optional[float]) -> Optional[ArenaLayout]:
+    """ArenaLayout over ``order`` — the Net's DWBP-ordered (layer, ParamDef)
+    table — restricted to ``include`` layers. None when nothing qualifies.
+    Both the trainer and any tool that needs to re-derive the layout call
+    this with the same inputs, so offsets always agree."""
+    slots: List[ArenaSlot] = []
+    off = 0
+    for lname, pdef in order:
+        if lname not in include:
+            continue
+        slots.append(ArenaSlot(lname, pdef.name, tuple(pdef.shape), off,
+                               pdef.count, pdef.lr_mult, pdef.decay_mult))
+        off += pdef.count
+    if not slots:
+        return None
+    return ArenaLayout(slots, bucket_mb)

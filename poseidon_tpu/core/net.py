@@ -213,9 +213,38 @@ class Net:
             if owned:
                 self.param_defs[layer.name] = owned
         self._layer_by_name = {l.name: l for l in self.layers}
+        # Static arena offset table (core/arena.py): every owner ParamDef in
+        # DWBP order — REVERSE forward layer order, the order gradients
+        # materialize during backward — so arena bucket 0 holds the leaves
+        # whose gradients exist first and the bucketed sync preserves the
+        # per-layer overlap structure. Computed here once; the trainer (and
+        # anything re-deriving a layout) restricts it to the comm config's
+        # arena-eligible layers via arena_layout().
+        self._arena_order: List[Tuple[str, ParamDef]] = [
+            (layer.name, pdef)
+            for layer in reversed(self.layers)
+            if layer.name in self.param_defs
+            for pdef in self.param_defs[layer.name]]
+        self._arena_layouts: Dict = {}
         if self.fuse_conv_epilogues:
             self._plan_epilogues()
         self._plan_layouts()
+
+    # ------------------------------------------------------------------ #
+    def arena_layout(self, include=None, bucket_mb: float = 4.0):
+        """The flat-parameter-arena layout over this net's DWBP-ordered
+        offset table, restricted to ``include`` layers (default: all param
+        layers) and cut into ~``bucket_mb`` MB collective buckets. Cached
+        per (include, bucket_mb) so the trainer, tests and tools always
+        agree on offsets. Returns None when nothing qualifies."""
+        from .arena import build_arena
+        inc = frozenset(self.param_defs) if include is None \
+            else frozenset(include)
+        key = (inc, bucket_mb)
+        if key not in self._arena_layouts:
+            self._arena_layouts[key] = build_arena(self._arena_order, inc,
+                                                   bucket_mb)
+        return self._arena_layouts[key]
 
     # ------------------------------------------------------------------ #
     def _plan_epilogues(self) -> None:

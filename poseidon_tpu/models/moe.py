@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 import jax
+from ..compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -238,7 +239,7 @@ def build_dp_ep_train_step(cfg: MoEConfig, sp: SolverParameter, mesh: Mesh,
         return new_params, new_state, metrics
 
     state_spec = SolverState(it=P(), history=specs)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_step, mesh=mesh,
         in_specs=(specs, state_spec, P((data_axis, expert_axis)),
                   P((data_axis, expert_axis)), P()),

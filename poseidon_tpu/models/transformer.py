@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Dict, Optional
 
 import jax
+from ..compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -217,7 +218,7 @@ def build_dp_sp_train_step(cfg: TransformerConfig, sp: SolverParameter,
         metrics = {"loss": lax.pmean(lax.pmean(loss, data_axis), seq_axis)}
         return new_params, new_state, metrics
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_step, mesh=mesh,
         in_specs=(P(), P(), P(data_axis, seq_axis), P(data_axis, seq_axis),
                   P()),
@@ -431,7 +432,7 @@ def build_dp_tp_train_step(cfg: TransformerConfig, sp: SolverParameter,
     tok_spec = (P(data_axis) if seq_axis is None
                 else P(data_axis, seq_axis))
     state_spec = SolverState(it=P(), history=specs)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_step, mesh=mesh,
         in_specs=(specs, state_spec, tok_spec, tok_spec, P()),
         out_specs=(specs, state_spec, P()),
@@ -609,7 +610,7 @@ def build_dp_pp_train_step(cfg: TransformerConfig, sp: SolverParameter,
         return new_params, new_state, metrics
 
     state_spec = SolverState(it=P(), history=specs)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_step, mesh=mesh,
         in_specs=(specs, state_spec, P(data_axis), P(data_axis), P()),
         out_specs=(specs, state_spec, P()),

@@ -71,6 +71,18 @@ def set_policy(**kwargs) -> None:
         setattr(_policy, k, v)
 
 
+def set_perf_policy(**overrides) -> None:
+    """THE bf16 perf config, in one place (bench.py and ``train --bf16``
+    both route here): MXU-native bfloat16 compute plus the space-to-depth
+    stem rewrite — conv1's 3 input channels use 3/128 MXU lanes, and the
+    rewrite is exact up to float summation order, so it rides every perf
+    run by default. Caffe-parity (f32) runs never come through here, so
+    golden-value comparisons keep the direct conv1 formulation."""
+    cfg = dict(compute_dtype=jnp.bfloat16, conv_s2d=True)
+    cfg.update(overrides)
+    set_policy(**cfg)
+
+
 @contextmanager
 def policy_scope(**kwargs):
     saved = {k: getattr(_policy, k) for k in kwargs}

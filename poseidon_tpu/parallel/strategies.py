@@ -132,6 +132,22 @@ class CommConfig:
     # exact shape). Distinctness is a prerequisite for overlap: a combined
     # collective can only start after the LAST gradient exists.
     dwbp_bucket_mb: Optional[float] = None
+    # Flat parameter arena (core/arena.py): pack DENSE f32 param leaves
+    # (and their grads + solver history, in-step) into one flat buffer with
+    # a static DWBP-ordered offset table, sync gradients as
+    # ceil(bytes / arena_bucket_mb) bucketed psums instead of one per leaf,
+    # and run the optimizer update as one fused elementwise pass with
+    # precomputed lr/decay multiplier segments. The update rule is
+    # bit-identical to the per-leaf path (the only step-level deltas are
+    # <= 1 ulp where XLA picks a different cross-replica reduction order
+    # for a bucketed all-reduce than for a tiny per-leaf psum); ON by
+    # default (the Bösen contiguous-row analog: costs must not scale with
+    # the NUMBER of tensors — GoogLeNet carries ~120).
+    # SFB/TOPK/LOCAL/DENSE_FUSED layers opt out and keep their custom
+    # paths. An explicit dwbp_bucket_mb request (per-backward chained taps)
+    # takes precedence over the arena on the per-step sync path.
+    param_arena: bool = True
+    arena_bucket_mb: float = 4.0
     # Blocked top-k selection: when set, magnitude/random TOPK picks the
     # top-k within fixed-size blocks of this many elements instead of one
     # global sort — the row-granular spirit of the reference's server, which
@@ -397,11 +413,40 @@ def topk_compress(g: jax.Array, fraction: float, error: jax.Array,
     return sent.reshape(g.shape), new_error
 
 
-class CommContext:
-    """Threaded through Net.apply; layers call back into it (core/layers.py)."""
+def chained_bucket_psums(bufs, axes: tuple, reduce: str,
+                         wire: Optional[str]):
+    """The arena's bucketed gradient sync: one ``wire_psum`` per bucket
+    buffer, chained by the same finite-token gate as ``_chained_sync_tap``
+    so XLA's all-reduce combiner cannot re-merge the buckets into one
+    end-of-backward collective (a merge would create a cycle). Buckets are
+    DWBP-ordered (bucket 0 = the last layers, whose gradients materialize
+    first in backward), so each collective can issue mid-backward the
+    moment its bucket's leaf cotangents are concatenated — the reference's
+    per-blob sync-thread overlap (solver.cpp:419-449) at bucket
+    granularity. The gate is the identity for finite tokens: values are
+    bit-identical to independent per-bucket (and per-leaf) psums."""
+    out = []
+    tok = None
+    for g in bufs:
+        if tok is not None:
+            g = jnp.where(tok < jnp.inf, g, jnp.full_like(g, jnp.nan))
+        s = wire_psum(g, axes, reduce, wire)
+        t = s[0].astype(jnp.float32)
+        tok = t if tok is None else jnp.minimum(tok, t)
+        out.append(s)
+    return tuple(out)
 
-    def __init__(self, cfg: CommConfig):
+
+class CommContext:
+    """Threaded through Net.apply; layers call back into it (core/layers.py).
+
+    ``arena_layers`` names the layers whose DENSE gradients ride the flat
+    parameter arena's bucketed post-backward psums instead of the in-
+    backward taps — ``tap_param`` leaves them untouched."""
+
+    def __init__(self, cfg: CommConfig, arena_layers=frozenset()):
         self.cfg = cfg
+        self.arena_layers = frozenset(arena_layers)
         self._token = None
         self._pending: list = []
         self._bucket_bytes = 0.0
@@ -420,6 +465,10 @@ class CommContext:
         # conv weights, (M, K=C*H*W) FC weights) — the layout plan presents
         # weights to NHWC convs via dimension numbers, never a reshaped
         # copy, so the cotangent psummed here is canonical under any plan.
+        if layer in self.arena_layers:
+            # the trainer psums this layer's gradient inside its arena
+            # bucket after (the relevant part of) backward — no tap here
+            return w
         strat = self.cfg.strategy_for(layer)
         if strat in (LOCAL, TOPK, DENSE_FUSED):
             # LOCAL: never synced. TOPK: the trainer compresses + psums the

@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
+from ..compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -26,9 +27,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.net import Net
 from ..proto.messages import SolverParameter
-from ..solvers.updates import SolverState, init_state, make_update_fn
-from .strategies import (CommConfig, CommContext, DENSE_FUSED, LOCAL, SFB,
-                         TOPK, budget_topk_fraction, comm_salt, topk_compress,
+from ..solvers.updates import (SolverState, init_state, make_arena_update_fn,
+                               make_update_fn)
+from .strategies import (CommConfig, CommContext, DENSE, DENSE_FUSED, LOCAL,
+                         SFB, TOPK, budget_topk_fraction,
+                         chained_bucket_psums, comm_salt, topk_compress,
                          wire_psum)
 
 
@@ -104,6 +107,10 @@ class TrainStep:
     # "NHWC" when the caller feeds channels-last directly so an NHWC-planned
     # net's hot path carries zero entry transposes — see core/net.py).
     input_layout: str = "NCHW"
+    # The flat-parameter-arena layout this step runs on (core/arena.py), or
+    # None when the per-leaf path is active. Introspection only — the step
+    # boundary representation is ALWAYS the canonical per-leaf tree.
+    arena: Optional[object] = None
 
 
 def comm_error_groups(comm: Optional[CommConfig], mesh: Mesh) -> int:
@@ -182,17 +189,18 @@ def build_train_step(
     ``lax.scan`` (grad INSIDE the scan body, so activation memory stays at
     one micro-batch), averages the accumulated gradients, then syncs and
     updates ONCE. batch_size B at iter_size K is numerically equivalent to
-    batch_size B*K (tested). Per-layer comm strategies collapse to one
-    post-accumulation dense psum (there is no per-micro-batch backward
-    exchange to tap — the DWBP/SFB structures are per-step mechanisms);
-    TOPK compression still applies, on the accumulated gradient."""
+    batch_size B*K (tested). There is no per-micro-batch backward exchange
+    to tap (the DWBP/SFB structures are per-step mechanisms), so the
+    post-accumulation sync routes DENSE layers through the flat parameter
+    arena's buckets — ceil(bytes/arena_bucket_mb) collectives — while SFB
+    and DENSE_FUSED layers get one dense psum per accumulated leaf; TOPK
+    compression still applies, on the accumulated gradient."""
     comm = comm or CommConfig()
     comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
     dcn = comm.dcn_axis
     axes = comm.sync_axes  # (dcn, data) or (data,)
     update_fn = make_update_fn(sp, param_mults(net))
-    ctx = CommContext(comm)
     n_total = int(np.prod([mesh.shape[a] for a in axes]))
 
     for lname in net.param_defs:
@@ -203,20 +211,48 @@ def build_train_step(
                 f"replicated; use build_ssp_train_step for per-device "
                 f"divergent parameters")
 
+    # Flat parameter arena (core/arena.py): DENSE layers' params, grads and
+    # solver history travel packed inside the step — gradients land in
+    # DWBP-ordered bucket buffers via the views custom-vjp, the data-
+    # parallel sync is ceil(bytes / arena_bucket_mb) chained psums instead
+    # of one per leaf, and the optimizer update is one fused elementwise
+    # pass with precomputed multiplier segments. SFB/TOPK/DENSE_FUSED
+    # layers keep their custom per-leaf paths. An explicit dwbp_bucket_mb
+    # (per-backward chained taps) takes precedence on the per-step path;
+    # under iter_size > 1 there is no per-backward exchange, so the
+    # accumulated sync rides the arena buckets either way.
+    dense_layers = [l for l in net.param_defs
+                    if comm.strategy_for(l) == DENSE]
+    arena = None
+    if comm.param_arena and dense_layers and \
+            (comm.dwbp_bucket_mb is None or iter_size > 1):
+        arena = net.arena_layout(frozenset(dense_layers),
+                                 comm.arena_bucket_mb)
+    arena_update = (make_arena_update_fn(sp, param_mults(net), arena)
+                    if arena is not None else None)
+    ctx = CommContext(comm, arena_layers=arena.layers
+                      if arena is not None else frozenset())
+
     if iter_size > 1:
+        # the arena covers DENSE layers' accumulated sync (bucketed psums);
+        # anything it does NOT cover still silently collapses to one dense
+        # post-accumulation psum per leaf — keep saying so
         sfb_layers = [l for l in net.param_defs
                       if comm.strategy_for(l) == SFB]
-        if sfb_layers or comm.dwbp_bucket_mb is not None:
+        what = []
+        if sfb_layers:
+            what.append(f"SFB layers {sfb_layers}")
+        if comm.dwbp_bucket_mb is not None and arena is None:
+            what.append(f"dwbp_bucket_mb={comm.dwbp_bucket_mb}")
+        if what:
             from ..runtime.metrics import log
-            what = []
-            if sfb_layers:
-                what.append(f"SFB layers {sfb_layers}")
-            if comm.dwbp_bucket_mb is not None:
-                what.append(f"dwbp_bucket_mb={comm.dwbp_bucket_mb}")
             log(f"WARNING: iter_size={iter_size} accumulates gradients "
-                f"before one dense post-accumulation psum; per-backward "
-                f"comm strategies ({', '.join(what)}) do not apply to the "
-                f"accumulated step")
+                f"before one dense post-accumulation psum per leaf for "
+                f"{', '.join(what)}; per-backward comm strategies do not "
+                f"apply to the accumulated step (DENSE layers ride the "
+                f"parameter arena's buckets"
+                + (")" if arena is not None else
+                   " when param_arena is on)"))
 
     topk_layers = [l for l in net.param_defs
                    if comm.strategy_for(l) == TOPK]
@@ -245,6 +281,15 @@ def build_train_step(
             flat_idx = flat_idx + mesh.shape[axis] * lax.axis_index(dcn)
         rng = jax.random.fold_in(rng, flat_idx)
 
+        # arena hot path: params packed once per step; the per-leaf tree
+        # the net consumes is rebuilt from bucket VIEWS whose custom-vjp
+        # concatenates each bucket's cotangents — gradients are "written
+        # into the arena" by backward itself
+        if arena is not None:
+            arena_w = arena.pack(params)
+            arena_bufs = arena.split_buckets(arena_w)
+            excl_params = arena.residual(params)
+
         if iter_size > 1:
             # gradient accumulation: grad INSIDE the scan body so only one
             # micro-batch's activations are ever live; metrics stack [K]
@@ -252,14 +297,24 @@ def build_train_step(
                 i, mb = xs
                 if input_transform is not None:
                     mb = input_transform(mb)
+                mrng = jax.random.fold_in(rng, i)
 
-                def micro_loss(p):
-                    o = net.apply(p, mb, train=True,
-                                  rng=jax.random.fold_in(rng, i), comm=None,
-                                  input_layout=input_layout)
-                    return o.loss, o
+                if arena is not None:
+                    def micro_loss(bufs, excl):
+                        p = arena.merge(arena.views(*bufs), excl)
+                        o = net.apply(p, mb, train=True, rng=mrng,
+                                      comm=None, input_layout=input_layout)
+                        return o.loss, o
 
-                g, o = jax.grad(micro_loss, has_aux=True)(params)
+                    g, o = jax.grad(micro_loss, argnums=(0, 1),
+                                    has_aux=True)(arena_bufs, excl_params)
+                else:
+                    def micro_loss(p):
+                        o = net.apply(p, mb, train=True, rng=mrng,
+                                      comm=None, input_layout=input_layout)
+                        return o.loss, o
+
+                    g, o = jax.grad(micro_loss, has_aux=True)(params)
                 acc = jax.tree_util.tree_map(jnp.add, acc, g)
                 m = {"loss": o.loss}
                 for name, val in o.outputs.items():
@@ -267,15 +322,27 @@ def build_train_step(
                         m[name] = val.astype(jnp.float32)
                 return acc, m
 
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            if arena is not None:
+                zeros = (tuple(jnp.zeros_like(b) for b in arena_bufs),
+                         jax.tree_util.tree_map(jnp.zeros_like, excl_params))
+            else:
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
             grads, micro_ms = lax.scan(
                 accum_body, zeros, (jnp.arange(iter_size), batch))
             # Caffe's SGDSolver::Normalize: scale accumulated grads by 1/K
             grads = jax.tree_util.tree_map(lambda g: g / iter_size, grads)
             out_scalars = {k: jnp.mean(v) for k, v in micro_ms.items()}
-            # one post-accumulation sync for every layer the per-backward
-            # taps would have handled (DENSE / SFB / DENSE_FUSED alike)
-            for lname in net.param_defs:
+            if arena is not None:
+                # the accumulated sync rides the SAME arena buckets as the
+                # per-step path: ceil(bytes/bucket) collectives, not one
+                # dense psum per leaf
+                bucket_grads, grads = grads
+                bucket_grads = chained_bucket_psums(
+                    bucket_grads, axes, comm.reduce, comm.wire_dtype)
+            # post-accumulation sync for the remaining per-leaf layers the
+            # per-backward taps would have handled (SFB / DENSE_FUSED, and
+            # DENSE itself when the arena is off)
+            for lname in grads:
                 if comm.strategy_for(lname) not in (LOCAL, TOPK):
                     for pname, g in grads[lname].items():
                         grads[lname][pname] = wire_psum(
@@ -285,13 +352,30 @@ def build_train_step(
             if input_transform is not None:
                 batch = input_transform(batch)
 
-            def loss_fn(p):
-                o = net.apply(p, batch, train=True, rng=rng, comm=ctx,
-                              keep_blobs=bool(dump_blobs),
-                              input_layout=input_layout)
-                return o.loss, o
+            if arena is not None:
+                def loss_fn(bufs, excl):
+                    p = arena.merge(arena.views(*bufs), excl)
+                    o = net.apply(p, batch, train=True, rng=rng, comm=ctx,
+                                  keep_blobs=bool(dump_blobs),
+                                  input_layout=input_layout)
+                    return o.loss, o
 
-            grads, out = jax.grad(loss_fn, has_aux=True)(params)
+                (bucket_grads, grads), out = jax.grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(arena_bufs,
+                                                           excl_params)
+                # the bucketed data-parallel sync: one DISTINCT (chained)
+                # collective per DWBP-ordered bucket, issued as its
+                # bucket's cotangents materialize mid-backward
+                bucket_grads = chained_bucket_psums(
+                    bucket_grads, axes, comm.reduce, comm.wire_dtype)
+            else:
+                def loss_fn(p):
+                    o = net.apply(p, batch, train=True, rng=rng, comm=ctx,
+                                  keep_blobs=bool(dump_blobs),
+                                  input_layout=input_layout)
+                    return o.loss, o
+
+                grads, out = jax.grad(loss_fn, has_aux=True)(params)
             out_scalars = {"loss": out.loss}
             for name, val in out.outputs.items():
                 if val.ndim == 0:
@@ -330,7 +414,13 @@ def build_train_step(
                 grads[lname][pname] = g_sync
                 lerr[pname] = resid[None]
             new_errors[lname] = lerr
-        new_params, new_solver = update_fn(params, grads, state.solver)
+        if arena is not None:
+            # fused flat update for the arena + per-leaf rule for opt-outs
+            new_params, new_solver = arena_update(
+                arena_w, arena.join_buckets(bucket_grads), excl_params,
+                grads, state.solver)
+        else:
+            new_params, new_solver = update_fn(params, grads, state.solver)
         metrics = {name: lax.psum(val.astype(jnp.float32), axes) / n_total
                    for name, val in out_scalars.items()}
         dumps = ({b: out.blobs[b] for b in (dump_blobs or ())}
@@ -371,7 +461,7 @@ def build_train_step(
         # K large without K on-device batch copies.
         scan_batch_spec = (P(*step_batch_spec) if scan_reuse_batch
                            else P(None, *step_batch_spec))
-        sharded = jax.shard_map(
+        sharded = shard_map(
             device_multi_step,
             mesh=mesh,
             in_specs=(P(), TrainState(P(), err_spec), scan_batch_spec, P()),
@@ -388,9 +478,10 @@ def build_train_step(
             scan_steps=scan_steps,
             iter_size=iter_size if iter_size > 1 else None,
             input_layout=input_layout,
+            arena=arena,
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_step,
         mesh=mesh,
         in_specs=(P(), TrainState(P(), err_spec), step_batch_spec, P()),
@@ -411,6 +502,7 @@ def build_train_step(
         lowerable=jitted,
         iter_size=iter_size if iter_size > 1 else None,
         input_layout=input_layout,
+        arena=arena,
     )
 
 
@@ -454,7 +546,7 @@ def build_eval_step(net: Net, mesh: Mesh, axis: str = "data",
                 metrics[name] = lax.psum(val.astype(jnp.float32), axes) / n_dev
         return metrics
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         device_eval, mesh=mesh,
         in_specs=(P(), batch_spec), out_specs=P(), check_vma=False))
 
@@ -569,6 +661,23 @@ def build_ssp_train_step(
     ici_ctx = (CommContext(dataclasses.replace(comm, dcn_axis=None))
                if dcn else None)
 
+    # Flat parameter arena for the SSP tier (flat mesh, "inc" server logic):
+    # the local update runs as one fused elementwise pass over the packed
+    # DENSE leaves, and the boundary delta exchange becomes
+    # ceil(bytes/arena_bucket_mb) psums over arena buckets instead of one
+    # per leaf. TOPK (compressed deltas) and LOCAL layers keep their
+    # per-leaf paths; adarevision consumes per-leaf raw gradient sums and a
+    # two-tier mesh taps DENSE gradients per-step intra-slice, so both fall
+    # back to the per-leaf step wholesale.
+    dense_layers = [l for l in net.param_defs
+                    if comm.strategy_for(l) == DENSE]
+    arena = None
+    if comm.param_arena and dense_layers and not adarev and not dcn:
+        arena = net.arena_layout(frozenset(dense_layers),
+                                 comm.arena_bucket_mb)
+    arena_update = (make_arena_update_fn(sp, param_mults(net), arena)
+                    if arena is not None else None)
+
     def device_step(ssp: SSPState, batch, rng):
         flat_idx = lax.axis_index(axis)
         if dcn:
@@ -599,8 +708,15 @@ def build_ssp_train_step(
             gsum = {ln: {pn: gsum[ln][pn] + grads[ln][pn]
                          for pn in grads[ln]}
                     for ln in gsum}
-        new_local, new_solver = update_fn(
-            local, grads, SolverState(it=ssp.it, history=history))
+        if arena is not None:
+            # fused flat local update over the packed DENSE leaves
+            new_local, new_solver = arena_update(
+                arena.pack(local), arena.pack(grads),
+                arena.residual(local), arena.residual(grads),
+                SolverState(it=ssp.it, history=history))
+        else:
+            new_local, new_solver = update_fn(
+                local, grads, SolverState(it=ssp.it, history=history))
 
         do_sync = (new_solver.it % period) == 0
         scale = 1.0 / n_groups if comm.reduce == "mean" else 1.0
@@ -633,6 +749,17 @@ def build_ssp_train_step(
             l, anchor, err, server, gs = args
             merged, new_anchor, new_err = {}, {}, dict(err)
             new_server, new_gs = dict(server), dict(gs)
+            if arena is not None:
+                # bucketed DENSE delta exchange over the arena: the flat
+                # delta's exact bucket ranges, one psum each — elementwise
+                # identical to the per-leaf psums they replace
+                flat_a = arena.pack(anchor)
+                flat_delta = arena.pack(l) - flat_a
+                summed = [wire_psum(b, (group_axis,), "sum",
+                                    comm.wire_dtype)
+                          for b in arena.split_buckets(flat_delta)]
+                arena_merged = arena.unpack(
+                    flat_a + scale * arena.join_buckets(summed))
             for lname, lp in l.items():
                 if lname in local_layers:
                     # LOCAL blobs never cross the wire (blob.cpp LOCAL mode)
@@ -656,6 +783,11 @@ def build_ssp_train_step(
                     new_server[lname], new_gs[lname] = ls, lg
                     continue
                 for pname, lv in lp.items():
+                    if arena is not None and arena.has(lname, pname):
+                        m = arena_merged[lname][pname]
+                        merged[lname][pname] = m
+                        new_anchor[lname][pname] = m
+                        continue
                     av = anchor[lname][pname]
                     delta = lv - av
                     if is_topk:
@@ -694,7 +826,7 @@ def build_ssp_train_step(
     g = group_axis
     batch_spec = P((dcn, axis)) if dcn else P(axis)
     ssp_spec = SSPState(P(g), P(g), P(), P(), P(g), P(), P(g))
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_step, mesh=mesh,
         in_specs=(ssp_spec, batch_spec, P()),
         out_specs=(ssp_spec, P()),
@@ -706,6 +838,7 @@ def build_ssp_train_step(
         batch_sharding=NamedSharding(mesh, batch_spec),
         replicated=NamedSharding(mesh, P()),
         lowerable=jitted,
+        arena=arena,
     )
 
 

@@ -47,6 +47,9 @@ def _engine_from_args(args, phase_nets=True):
                       dwbp_bucket_mb=(
                           None if getattr(args, "dwbp_bucket_mb", -1.0) < 0
                           else args.dwbp_bucket_mb),
+                      param_arena=(getattr(args, "param_arena", "true")
+                                   == "true"),
+                      arena_bucket_mb=getattr(args, "arena_bucket_mb", 4.0),
                       server_logic=getattr(args, "server_logic", "inc"),
                       adarev_init_step=getattr(args, "adarev_init_step", 0.1))
     if args.sfb_auto:
@@ -97,9 +100,8 @@ def _engine_from_args(args, phase_nets=True):
 def cmd_train(args) -> int:
     from .cluster import init_distributed
     if args.bf16:
-        import jax.numpy as jnp
         from .. import config
-        config.set_policy(compute_dtype=jnp.bfloat16)
+        config.set_perf_policy()
     if getattr(args, "async_ssp", False):
         # async-SSP: the processes stay INDEPENDENT jax runtimes — no
         # jax.distributed world, no collective rendezvous; the only
@@ -631,9 +633,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "mid-backward (the reference's per-blob sync-thread "
                         "structure, solver.cpp:419-449); 0 = one per blob, "
                         "negative = off (XLA's combiner decides)")
+    t.add_argument("--param_arena", default="true",
+                   choices=["true", "false"],
+                   help="flat parameter arena (ON by default): pack DENSE "
+                        "param/grad/momentum leaves into one flat buffer, "
+                        "sync gradients as ceil(bytes/arena_bucket_mb) "
+                        "bucketed collectives instead of one per leaf, and "
+                        "run the optimizer update as one fused pass; same "
+                        "numbers as the per-leaf path (update rule bitwise, "
+                        "steps within 1 ulp of collective reduction order)")
+    t.add_argument("--arena_bucket_mb", type=float, default=4.0,
+                   help="arena gradient-sync bucket size in MB (DWBP-"
+                        "ordered exact element ranges; <= 0 = one bucket "
+                        "per leaf)")
     t.add_argument("--bf16", action="store_true",
-                   help="bfloat16 compute (MXU-native); params/updates stay "
-                        "f32. Default f32 matches Caffe numerics exactly")
+                   help="the bf16 perf config: bfloat16 compute (MXU-"
+                        "native) + the exact space-to-depth stem rewrite; "
+                        "params/updates stay f32. Default f32 matches "
+                        "Caffe numerics exactly (direct conv1 formulation)")
     t.add_argument("--dcn_slices", type=int, default=0,
                    help="split devices into N slices on a slow (DCN) mesh "
                         "axis: dense sync intra-slice, TOPK-compressed "

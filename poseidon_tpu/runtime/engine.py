@@ -189,7 +189,8 @@ class Engine:
                 replicated=ssp_ts.replicated,
                 # NOTE: the SSP lowerable has the 3-arg (state, batch, rng)
                 # signature, not the wrapper's 4-arg one
-                lowerable=ssp_ts.lowerable)
+                lowerable=ssp_ts.lowerable,
+                arena=ssp_ts.arena)
         else:
             dump = sorted({b for _, bs in self._h5_train for b in bs})
             if dump and self.iter_size > 1:

@@ -151,6 +151,45 @@ def parse_collectives(hlo_text: str) -> List[Collective]:
     return out
 
 
+def count_gradient_all_reduces(hlo_text: str,
+                               min_payload_bytes: int = 1024) -> int:
+    """Gradient all-reduces in a compiled step: all-reduce ops with a
+    non-trivial replica group and a payload big enough to be a gradient
+    (the metrics / mean-divisor psums are scalars and fall under the
+    threshold). This is the flat-parameter-arena acceptance counter: the
+    data-parallel step must carry <= ceil(total_grad_bytes /
+    arena_bucket_mb) of these, vs one per leaf on the per-leaf path."""
+    return sum(1 for c in parse_collectives(hlo_text)
+               if c.kind == "all-reduce" and c.group_size > 1
+               and c.payload_bytes >= min_payload_bytes)
+
+
+# one stablehlo.all_reduce op, non-greedy to ITS result type: the reduction
+# region between the op and its `-> tensor<...>` signature contains no `->`
+_STABLEHLO_AR_RE = re.compile(
+    r'"stablehlo\.all_reduce".*?\)\s*->\s*tensor<([0-9x]*)f32>', re.S)
+
+
+def count_gradient_all_reduces_stablehlo(text: str,
+                                         min_elements: int = 256) -> int:
+    """Gradient all-reduces in a LOWERED (pre-XLA) program — the cheap
+    counter for tests that cannot afford a multi-minute CPU compile of a
+    big net. Counts ``stablehlo.all_reduce`` ops whose f32 payload is big
+    enough to be a gradient (metrics / mean-divisor psums are scalars).
+    An upper bound on the compiled count: XLA's combiner may merge
+    all-reduces but never splits one — and the arena's chained bucket
+    psums cannot legally merge at all (the chain would cycle), which
+    ``count_gradient_all_reduces`` pins on the compiled text where the
+    compile is affordable."""
+    n = 0
+    for m in _STABLEHLO_AR_RE.finditer(text):
+        dims = m.group(1).rstrip("x")
+        elems = int(np.prod([int(d) for d in dims.split("x")])) if dims else 1
+        if elems >= min_elements:
+            n += 1
+    return n
+
+
 def measured_comm_summary(colls: List[Collective],
                           min_payload_bytes: int = 16) -> Dict:
     """Totals comparable against comm_stats.comm_summary(): per-device wire

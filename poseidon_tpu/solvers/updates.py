@@ -63,45 +63,132 @@ def _regularized(g, w, local_decay: float, reg_type: str):
     raise ValueError(f"unknown regularization_type {reg_type!r}")
 
 
+def _leafwise_update(sp: SolverParameter, mults, rate, params, grads,
+                     history):
+    """One optimizer step over a per-leaf tree (the classic path; also the
+    per-leaf remainder — SFB/TOPK/LOCAL opt-outs — of an arena step)."""
+    solver_type = sp.solver_type
+    momentum = sp.momentum
+    weight_decay = sp.weight_decay
+    reg_type = sp.regularization_type
+    delta = sp.delta
+    new_params = {}
+    new_hist = {}
+    for lname, lparams in params.items():
+        new_params[lname] = {}
+        new_hist[lname] = {}
+        for pname, w in lparams.items():
+            g = grads[lname][pname]
+            lr_mult, decay_mult = mults[lname][pname]
+            local_rate = rate * lr_mult
+            local_decay = weight_decay * decay_mult
+            h = history[lname][pname]
+            g = _regularized(g.astype(jnp.float32), w, local_decay, reg_type)
+            if solver_type == "SGD":
+                h_new = momentum * h + local_rate * g
+                step = h_new
+            elif solver_type == "NESTEROV":
+                h_new = momentum * h + local_rate * g
+                step = (1.0 + momentum) * h_new - momentum * h
+            elif solver_type == "ADAGRAD":
+                h_new = h + g * g
+                step = local_rate * g / (jnp.sqrt(h_new) + delta)
+            else:
+                raise ValueError(f"unknown solver_type {solver_type!r}")
+            new_params[lname][pname] = (w - step).astype(w.dtype)
+            new_hist[lname][pname] = h_new
+    return new_params, new_hist
+
+
 def make_update_fn(sp: SolverParameter, mults: Dict[str, Dict[str, tuple]]):
     """Build update(params, grads, state) -> (params, state).
 
     ``mults`` maps layer -> param name -> (lr_mult, decay_mult), from the
     net's ParamDefs (the reference's blobs_lr / weight_decay lists).
     """
-    solver_type = sp.solver_type
-    momentum = sp.momentum
-    weight_decay = sp.weight_decay
-    reg_type = sp.regularization_type
-    delta = sp.delta
-
     def update(params, grads, state: SolverState):
         rate = learning_rate(sp, state.it)
-        new_params = {}
-        new_hist = {}
-        for lname, lparams in params.items():
-            new_params[lname] = {}
-            new_hist[lname] = {}
-            for pname, w in lparams.items():
-                g = grads[lname][pname]
-                lr_mult, decay_mult = mults[lname][pname]
-                local_rate = rate * lr_mult
-                local_decay = weight_decay * decay_mult
-                h = state.history[lname][pname]
-                g = _regularized(g.astype(jnp.float32), w, local_decay, reg_type)
-                if solver_type == "SGD":
-                    h_new = momentum * h + local_rate * g
-                    step = h_new
-                elif solver_type == "NESTEROV":
-                    h_new = momentum * h + local_rate * g
-                    step = (1.0 + momentum) * h_new - momentum * h
-                elif solver_type == "ADAGRAD":
-                    h_new = h + g * g
-                    step = local_rate * g / (jnp.sqrt(h_new) + delta)
-                else:
-                    raise ValueError(f"unknown solver_type {solver_type!r}")
-                new_params[lname][pname] = (w - step).astype(w.dtype)
-                new_hist[lname][pname] = h_new
+        new_params, new_hist = _leafwise_update(sp, mults, rate, params,
+                                                grads, state.history)
+        return new_params, SolverState(it=state.it + 1, history=new_hist)
+
+    return update
+
+
+def make_fused_update_fn(sp: SolverParameter, layout):
+    """One fused elementwise pass over the flat arena buffer — the same
+    SGD/Nesterov/AdaGrad rule as ``_leafwise_update``, with the per-leaf
+    lr_mult / decay_mult scalars expanded into the layout's precomputed
+    arena-resident multiplier segments. Bit-identical to the per-leaf loop:
+    every scalar is rounded to f32 exactly where the per-leaf path rounds
+    it (see ArenaLayout.mult_vectors), the zero-decay skip becomes an
+    elementwise select of the untouched gradient, and the operation order
+    is unchanged.
+
+    Returns fused(flat_w, flat_g, flat_h, rate) -> (flat_w', flat_h').
+    The SGD+momentum+L2 shape (the Caffe default) can additionally route
+    through the Pallas kernel variant (ops/pallas_kernels.fused_sgd) —
+    opt-in via POSEIDON_PALLAS_UPDATE=1, same math, one VMEM pass."""
+    solver_type = sp.solver_type
+    momentum = sp.momentum
+    reg_type = sp.regularization_type
+    delta = sp.delta
+    lr_np, decay_np = layout.mult_vectors(sp.weight_decay)
+    if solver_type not in ("SGD", "NESTEROV", "ADAGRAD"):
+        raise ValueError(f"unknown solver_type {solver_type!r}")
+    if reg_type not in ("L2", "L1"):
+        raise ValueError(f"unknown regularization_type {reg_type!r}")
+
+    def fused(flat_w, flat_g, flat_h, rate):
+        lr_vec = jnp.asarray(lr_np)
+        decay_vec = jnp.asarray(decay_np)
+        local_rate = rate * lr_vec
+        g = flat_g.astype(jnp.float32)
+        if solver_type == "SGD" and reg_type == "L2":
+            from ..ops.pallas_kernels import maybe_fused_sgd
+            r = maybe_fused_sgd(flat_w, g, flat_h, local_rate, decay_vec,
+                                momentum)
+            if r is not None:
+                return r
+        reg = flat_w if reg_type == "L2" else jnp.sign(flat_w)
+        # the elementwise form of _regularized's local_decay == 0 skip:
+        # untouched gradient where the segment's decay is zero
+        g = jnp.where(decay_vec == 0.0, g, g + decay_vec * reg)
+        if solver_type == "SGD":
+            h_new = momentum * flat_h + local_rate * g
+            step = h_new
+        elif solver_type == "NESTEROV":
+            h_new = momentum * flat_h + local_rate * g
+            step = (1.0 + momentum) * h_new - momentum * flat_h
+        else:  # ADAGRAD
+            h_new = flat_h + g * g
+            step = local_rate * g / (jnp.sqrt(h_new) + delta)
+        return (flat_w - step).astype(flat_w.dtype), h_new
+
+    return fused
+
+
+def make_arena_update_fn(sp: SolverParameter, mults, layout):
+    """The arena step's optimizer update: the fused flat pass for arena
+    leaves + the per-leaf rule for opt-outs, one iteration bump.
+
+    update(flat_w, flat_g, excl_params, excl_grads, state)
+        -> (new_params_tree, new_state)
+
+    ``state.history`` is the CANONICAL per-leaf tree at every step boundary
+    (snapshots never see the packed form); it is packed here for the fused
+    pass and unpacked into the returned state."""
+    fused = make_fused_update_fn(sp, layout)
+
+    def update(flat_w, flat_g, excl_params, excl_grads, state: SolverState):
+        rate = learning_rate(sp, state.it)
+        flat_h = layout.pack(state.history)
+        new_flat_w, new_flat_h = fused(flat_w, flat_g, flat_h, rate)
+        excl_hist = layout.residual(state.history)
+        new_excl, new_excl_hist = _leafwise_update(
+            sp, mults, rate, excl_params, excl_grads, excl_hist)
+        new_params = layout.merge(layout.unpack(new_flat_w), new_excl)
+        new_hist = layout.merge(layout.unpack(new_flat_h), new_excl_hist)
         return new_params, SolverState(it=state.it + 1, history=new_hist)
 
     return update

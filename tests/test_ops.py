@@ -271,6 +271,43 @@ def test_conv_space_to_depth_exact(rng_np, c, k, s, p, h):
                                    rtol=1e-3, atol=3e-4, err_msg=name)
 
 
+def test_s2d_real_stems_parity_and_perf_config_default(rng_np):
+    """The bf16 perf config (numeric.set_perf_policy — what bench.py and
+    ``train --bf16`` run) flips conv_s2d ON; this pins the rewrite at the
+    REAL stem configurations. f32 parity is checked at float-sum-rebracket
+    tolerance against the direct conv1 formulation for both stems:
+    AlexNet conv1 (96x3x11x11 / s4 / p0 @ 227) and GoogLeNet conv1
+    (64x3x7x7 / s2 / p3 @ 224)."""
+    import jax.numpy as jnp
+    from poseidon_tpu import config
+    from poseidon_tpu.config import policy_scope
+
+    # the perf config's defaults, restored by hand (set_perf_policy has no
+    # scope form — it is the bench/CLI entry point)
+    saved = (config.policy().compute_dtype, config.policy().conv_s2d)
+    try:
+        config.set_perf_policy()
+        assert config.policy().compute_dtype == jnp.bfloat16
+        assert config.policy().conv_s2d is True
+    finally:
+        config.set_policy(compute_dtype=saved[0], conv_s2d=saved[1])
+
+    stems = [
+        ("alexnet_conv1", 96, 11, 4, 0, 227),
+        ("googlenet_conv1", 64, 7, 2, 3, 224),
+    ]
+    for name, o, k, s, p, h in stems:
+        x = rng_np.randn(1, 3, h, h).astype(np.float32)
+        w = (rng_np.randn(o, 3, k, k).astype(np.float32) / k)
+        b = rng_np.randn(o).astype(np.float32)
+        y_direct = np.asarray(NN.conv2d(x, w, b, (s, s), (p, p), 1))
+        with policy_scope(conv_s2d=True):
+            y_s2d = np.asarray(NN.conv2d(x, w, b, (s, s), (p, p), 1))
+        assert y_direct.shape == y_s2d.shape, name
+        np.testing.assert_allclose(y_s2d, y_direct, rtol=1e-5, atol=1e-5,
+                                   err_msg=name)
+
+
 def test_conv_space_to_depth_skips_many_channel_convs(rng_np):
     """The rewrite must only fire on lane-starved stems (C <= 4)."""
     import jax.numpy as jnp

@@ -35,6 +35,7 @@ def test_overlap_fraction_interval_math():
 def test_overlap_tool_on_real_trace(tmp_path):
     import jax
     import jax.numpy as jnp
+    from poseidon_tpu.compat import shard_map
     from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -46,7 +47,7 @@ def test_overlap_tool_on_real_trace(tmp_path):
         g = jnp.tanh(x) @ jnp.ones((256, 256), x.dtype)
         return lax.psum(g, "data").sum()
 
-    step = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("data"),
+    step = jax.jit(shard_map(f, mesh=mesh, in_specs=P("data"),
                                  out_specs=P(), check_vma=False))
     x = jnp.ones((16, 256))
     step(x).block_until_ready()

@@ -354,6 +354,45 @@ def test_dwbp_bucket_grouping(mesh, lenet_net, rng_np):
     assert bucketed < per_blob, (bucketed, per_blob)
 
 
+def test_arena_sfb_topk_layers_opt_out(mesh, lenet_net, rng_np):
+    """SFB and TOPK layers keep their custom comm paths under the flat
+    parameter arena: the arena layout excludes them, and a mixed-strategy
+    step is bit-identical with the arena on and off (same SFB factor
+    gathers, same TOPK compression + error feedback, same DENSE arena
+    leaves)."""
+    import dataclasses
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0005)
+    params = lenet_net.init(jax.random.PRNGKey(0))
+    batch = _global_batch(rng_np)
+    comm = CommConfig(layer_strategies={"ip1": SFB, "conv2": "topk"},
+                      topk_fraction=0.1)
+    results = []
+    for arena_on in (True, False):
+        cc = dataclasses.replace(comm, param_arena=arena_on)
+        ts = build_train_step(lenet_net, sp, mesh, cc, donate=False)
+        if arena_on:
+            # opt-outs: only the DENSE layers live in the arena
+            assert ts.arena is not None
+            assert ts.arena.layers == {"conv1", "ip2"}
+        p, s = params, init_train_state(params, cc, N_DEV)
+        for i in range(2):
+            p, s, m = ts.step(p, s, batch, jax.random.PRNGKey(i))
+        results.append((p, s))
+    (p1, s1), (p2, s2) = results
+    for l in p1:
+        for k in p1[l]:
+            np.testing.assert_array_equal(
+                np.asarray(p1[l][k]), np.asarray(p2[l][k]),
+                err_msg=f"{l}/{k}")
+    # TOPK error-feedback residuals agree too (same compression inputs)
+    for l in s1.comm_error:
+        for k in s1.comm_error[l]:
+            np.testing.assert_array_equal(
+                np.asarray(s1.comm_error[l][k]),
+                np.asarray(s2.comm_error[l][k]), err_msg=f"err {l}/{k}")
+
+
 def test_auto_strategies_picks_sfb_for_big_fc():
     net = Net(zoo.alexnet(), phase="TRAIN",
               source_shapes=zoo.alexnet_shapes(32))

@@ -123,6 +123,67 @@ def test_engine_snapshot_restore(tmp_path):
         eng3.close()
 
 
+def test_arena_snapshot_portability(tmp_path):
+    """Snapshots are canonical per-leaf under the flat parameter arena: a
+    per-leaf snapshot written before the arena existed loads into an
+    arena-backed run, trains, re-snapshots, and that snapshot reloads with
+    --param_arena=false bit-identically — the same training continuation
+    either way (params, momentum history, iteration)."""
+    from poseidon_tpu.parallel import CommConfig
+    from poseidon_tpu.proto.messages import load_solver
+    from poseidon_tpu.runtime.checkpoint import restore
+    from poseidon_tpu.runtime.engine import Engine
+
+    solver_path = _write_mnistish_prototxt(tmp_path, max_iter=6)
+
+    def run(arena: bool, outdir: str, resume=None, to_iter=6):
+        sp = load_solver(solver_path)
+        sp.snapshot_after_train = True
+        eng = Engine(sp, comm=CommConfig(param_arena=arena),
+                     memory_data=_memory_data(), output_dir=outdir)
+        try:
+            assert (eng.train_step.arena is not None) == arena
+            if resume:
+                eng.restore_from(resume)
+            eng.train(max_iter=to_iter)
+        finally:
+            eng.close()
+        return os.path.join(outdir, "snap",
+                            f"smallnet_iter_{to_iter}.solverstate.npz")
+
+    # 1) the "pre-arena" snapshot: a per-leaf run to iter 6
+    base = run(False, str(tmp_path / "leaf"))
+    assert os.path.exists(base)
+    # 2) continue 6 -> 9 under the arena, and per-leaf as the reference
+    snap_arena = run(True, str(tmp_path / "arena9"), resume=base, to_iter=9)
+    snap_leaf = run(False, str(tmp_path / "leaf9"), resume=base, to_iter=9)
+    pa, sa = restore(snap_arena)
+    pl, sl = restore(snap_leaf)
+    assert int(sa.solver.it) == int(sl.solver.it) == 9
+    for l in pa:
+        for k in pa[l]:
+            np.testing.assert_array_equal(
+                np.asarray(pa[l][k]), np.asarray(pl[l][k]),
+                err_msg=f"params {l}/{k}")
+            np.testing.assert_array_equal(
+                np.asarray(sa.solver.history[l][k]),
+                np.asarray(sl.solver.history[l][k]),
+                err_msg=f"history {l}/{k}")
+    # 3) the arena run's snapshot reloads into a per-leaf run and trains —
+    # continuation parity 9 -> 12 across the representation boundary
+    snap_a12 = run(False, str(tmp_path / "a12"), resume=snap_arena,
+                   to_iter=12)
+    snap_l12 = run(True, str(tmp_path / "l12"), resume=snap_leaf,
+                   to_iter=12)
+    pa12, _ = restore(snap_a12)
+    pl12, _ = restore(snap_l12)
+    for l in pa12:
+        for k in pa12[l]:
+            np.testing.assert_array_equal(
+                np.asarray(pa12[l][k]), np.asarray(pl12[l][k]),
+                err_msg=f"12 {l}/{k}")
+
+
 def test_stale_snapshot_tmp_swept_and_never_shadows(tmp_path):
     """Crash-safe snapshot hygiene: a process killed between tmp-write and
     os.replace leaves ``*_iter_N.*.tmp.<pid>`` litter. The sweep removes

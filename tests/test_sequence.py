@@ -3,6 +3,7 @@
 import functools
 
 import jax
+from poseidon_tpu.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -31,7 +32,7 @@ def qkv(rng_np=None):
 
 
 def _sharded(mesh, fn, causal):
-    wrapped = jax.shard_map(
+    wrapped = shard_map(
         functools.partial(fn, axis="seq", causal=causal),
         mesh=mesh,
         in_specs=(P(None, None, "seq"), P(None, None, "seq"),
@@ -60,7 +61,7 @@ def test_ulysses_attention_matches_full(mesh, qkv, causal):
 
 
 def _sharded_flash(mesh, causal, block=8):
-    wrapped = jax.shard_map(
+    wrapped = shard_map(
         lambda q, k, v: ring_flash_attention(q, k, v, "seq", causal, None,
                                              block, True),
         mesh=mesh,
