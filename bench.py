@@ -222,16 +222,24 @@ def _time_step(ts, params, state, batch, iters: int):
     return dt / iters / (ts.scan_steps or 1), params, state, m
 
 
-def _time_dispatch_walls(ts, params, state, batch, dispatches: int):
+def _time_dispatch_walls(ts, params, state, batch, dispatches: int,
+                         warmup: int = 2):
     """Per-dispatch wall times, each individually blocked. The MIN wall is
     the robust estimator under the tunnel's one-sided noise (a dispatch can
     be late, never early): round-3 K-vs-2K differencing failed because the
     averaged walls carried multi-second jitter spikes that swamped the
-    device-time difference."""
+    device-time difference.
+
+    ``warmup`` dispatches run un-timed first (>= 2): the first call pays
+    trace+compile, and the SECOND can still pay one-time runtime work
+    (autotuned executable upload, allocator growth) — round 5's
+    16368 ms googlenet "overhead" was compile-adjacent time caught in one
+    series of the K-vs-2K differencing because only one variant was warm."""
     import jax
     rng = jax.random.PRNGKey(1)
-    params, state, m = ts.step(params, state, batch, rng)  # compile+warmup
-    jax.block_until_ready(m["loss"])
+    for _ in range(max(1, warmup)):
+        params, state, m = ts.step(params, state, batch, rng)
+        jax.block_until_ready(m["loss"])
     walls = []
     for _ in range(dispatches):
         t0 = time.perf_counter()
@@ -255,6 +263,128 @@ def _dispatch_roundtrip_ms(iters: int = 12) -> float:
         v = bump(v)
         jax.block_until_ready(v)
     return (time.perf_counter() - t0) / iters * 1e3
+
+
+_PIPELINE_AB_NET = """
+name: "pipe_ab"
+layers { name: "src" type: MEMORY_DATA top: "data" top: "label"
+  memory_data_param { batch_size: %d channels: 3 height: 24 width: 24 } }
+layers { name: "conv1" type: CONVOLUTION bottom: "data" top: "conv1"
+  convolution_param { num_output: 16 kernel_size: 3
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } } }
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers { name: "pool1" type: POOLING bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layers { name: "ip1" type: INNER_PRODUCT bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 10
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } } }
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "ip1" bottom: "label"
+  top: "loss" }
+"""
+
+
+def _pipeline_ab(iters: int, per_dev_batch: int = 16) -> dict:
+    """Pipelined-vs-serial A/B of the ENGINE loop itself (the tentpole of
+    the step pipeline): the serial arm device_puts each batch inline and
+    drains every step's metrics before dispatching the next
+    (device_prefetch=0, max_in_flight=1 — the fully serial baseline); the
+    pipelined arm stages batches to device in the
+    background and runs the bounded in-flight dispatch window. Both train
+    the same MEMORY_DATA conv net through real BatchPipelines, so host
+    feeding is on the measured path — exactly what the pipeline hides.
+    Returns {pipeline_speedup, *_step_ms, input_stall_ms_per_step,
+    steps_in_flight}.
+
+    Calibration: on CPU the pipeline is structurally ~neutral (there is
+    no host->device link to hide, the prefetch stage runs in passthrough
+    mode, and CPU dispatch is effectively synchronous), so the smoke's
+    speedup measures ~1.0 +- the box's noise floor; the real win needs an
+    accelerator backend, where the prefetch thread overlaps the transfer
+    and the in-flight window hides the dispatch round-trip that BENCH_r05
+    measured at hundreds of ms on the tunneled runtime."""
+    import jax
+    from poseidon_tpu.proto.messages import (SolverParameter,
+                                             load_net_from_string)
+    from poseidon_tpu.runtime.engine import Engine
+
+    net_param = load_net_from_string(_PIPELINE_AB_NET % per_dev_batch)
+    rs = np.random.RandomState(0)
+    md = {"data": rs.randn(512, 3, 24, 24).astype(np.float32),
+          "label": rs.randint(0, 10, 512)}
+    out: dict = {}
+
+    def _mk(device_prefetch, max_in_flight):
+        import tempfile
+        sp = SolverParameter(train_net_param=net_param, base_lr=0.01,
+                             lr_policy="fixed", momentum=0.9, display=0,
+                             max_iter=0, random_seed=3)
+        eng = Engine(sp, memory_data=md,
+                     output_dir=tempfile.mkdtemp(prefix="pipe_ab_"),
+                     device_prefetch=device_prefetch,
+                     max_in_flight=max_in_flight)
+        # every timed window is one train() call; its end-of-train
+        # artifact writes (stats.yaml + CSV) are disk noise inside the
+        # perf window — suppress them for the A/B engines only
+        eng._write_artifacts = lambda: None
+        return eng
+
+    serial = _mk(0, 1)
+    piped = _mk(int(os.environ.get("POSEIDON_BENCH_DEVICE_PREFETCH", "2")),
+                int(os.environ.get("POSEIDON_BENCH_MAX_IN_FLIGHT", "2")))
+    try:
+        # warmup: compile + pipeline fill; steady-state stall only below
+        # (the fill/compile-window waits must not contaminate the metric)
+        serial.train(max_iter=3)
+        piped.train(max_iter=3)
+        stall0 = {e: e.stats.timers.get("input_stall", 0.0)
+                  for e in (serial, piped)}
+        n0 = {e: e.stats.counters.get("train_iters", 0.0)
+              for e in (serial, piped)}
+        # INTERLEAVED windows + min: both arms sample the same host-load
+        # epochs (a drifting box cannot bias one arm), and the noise is
+        # one-sided (a window can be slowed by background load, never
+        # sped up), so min() is each arm's clean run — the same
+        # estimator as the dispatch walls
+        windows = int(os.environ.get("POSEIDON_BENCH_PIPELINE_WINDOWS",
+                                     "12"))
+        dts = {serial: [], piped: []}
+        done = 3
+        for w in range(windows):
+            # alternate which arm goes first: under cgroup CPU throttling
+            # the first runner of a period systematically gets the burst
+            # budget, which would bias a fixed order by a few percent
+            order = (serial, piped) if w % 2 == 0 else (piped, serial)
+            for eng in order:
+                t0 = time.perf_counter()
+                eng.train(max_iter=done + iters)
+                dts[eng].append((time.perf_counter() - t0) / iters)
+            done += iters
+
+        def _stall(eng):
+            n = max(eng.stats.counters.get("train_iters", 0.0) - n0[eng], 1)
+            return (eng.stats.timers.get("input_stall", 0.0)
+                    - stall0[eng]) / n
+
+        serial_s, piped_s = min(dts[serial]), min(dts[piped])
+        # the headline ratio is the MEDIAN of paired per-window ratios:
+        # pairing cancels epoch drift that min/min cannot (each arm's min
+        # may come from different epochs), and the median rejects the
+        # occasional throttled window outright
+        ratios = sorted(a / b for a, b in zip(dts[serial], dts[piped]))
+        speedup = ratios[len(ratios) // 2] if len(ratios) % 2 else \
+            0.5 * (ratios[len(ratios) // 2 - 1] + ratios[len(ratios) // 2])
+        serial_stall, piped_stall = _stall(serial), _stall(piped)
+        in_flight = piped.stats.counters.get("steps_in_flight", 0.0)
+    finally:
+        serial.close()
+        piped.close()
+    out["pipeline_serial_step_ms"] = round(serial_s * 1e3, 3)
+    out["pipeline_step_ms"] = round(piped_s * 1e3, 3)
+    out["pipeline_speedup"] = round(speedup, 4)
+    out["input_stall_ms_per_step"] = round(piped_stall * 1e3, 3)
+    out["input_stall_serial_ms_per_step"] = round(serial_stall * 1e3, 3)
+    out["steps_in_flight"] = in_flight
+    return out
 
 
 def _step_flops(ts, params, state, batch) -> float:
@@ -431,6 +561,16 @@ def main() -> None:
                 "noisy": "2k" if spread(walls_b) >= spread(walls_a) else "k",
                 "k_spread": round(spread(walls_a), 3),
                 "2k_spread": round(spread(walls_b), 3)}
+        # sanity invariant (round-5 verdict: googlenet overhead 16368 ms >
+        # the dispatch itself): the overhead estimate must satisfy
+        # 0 <= overhead < the K-dispatch wall — anything outside is a
+        # differencing artifact, clamped and flagged, never reported raw
+        if not 0.0 <= overhead < disp_a:
+            extras.setdefault("dispatch_overhead_clamped", {})[model] = \
+                round(overhead * 1e3, 3)
+            overhead = min(max(overhead, 0.0), max(floor_s, 0.0),
+                           0.5 * disp_a)
+        assert 0.0 <= overhead < max(disp_a, 1e-12), (overhead, disp_a)
         # raw dispatch walls so a failed differencing is diagnosable from
         # the JSON alone (is 2K genuinely not slower, or just noisy?)
         extras.setdefault("dispatch_walls_ms", {})[model] = {
@@ -565,6 +705,14 @@ def main() -> None:
             extras["s2d_speedup"] = round(off_s / on_s, 4)
             del ts5, p5, s5, b5
             checkpoint_partial(extras, "s2d_ab")
+
+        # ---- Step-pipeline A/B: prefetch + in-flight window vs serial -----
+        if os.environ.get("POSEIDON_BENCH_PIPELINE_AB", "1") == "1" and \
+                budget_left("pipeline_ab"):
+            extras.update(_pipeline_ab(
+                int(os.environ.get("POSEIDON_BENCH_PIPELINE_ITERS",
+                                   "30" if cpu_ok else "50"))))
+            checkpoint_partial(extras, "pipeline_ab")
 
         # ---- TOPK selection cost at fc6 scale: global vs blocked ----------
         if os.environ.get("POSEIDON_BENCH_TOPK",

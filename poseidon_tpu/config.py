@@ -21,7 +21,7 @@ from dataclasses import dataclass
 # module __getattr__ below (PEP 562).
 _NUMERIC_NAMES = frozenset({
     "Policy", "policy", "set_policy", "set_perf_policy", "policy_scope",
-    "matmul_precision",
+    "matmul_precision", "resolve_conv_layout",
 })
 
 
@@ -69,6 +69,45 @@ def set_fault_config(**kwargs) -> None:
         if not hasattr(_fault, k):
             raise AttributeError(k)
         setattr(_fault, k, v)
+
+
+@dataclass
+class PipelineConfig:
+    """Step-pipeline policy for the training loop (runtime/engine.py).
+
+    The serialized baseline loop device_puts each batch on the train
+    thread, blocks on every step's metrics, and writes snapshots inline;
+    these knobs run the host<->device boundary as a pipeline instead —
+    device-side input prefetch, a bounded in-flight dispatch window, and
+    background snapshot serialization. All three are numerics-neutral:
+    the dispatched step sequence is identical, only host blocking moves
+    (tests/test_pipeline_overlap.py pins bitwise parity)."""
+
+    # host batches staged to device AHEAD of the step that consumes them
+    # (data.pipeline.DevicePrefetcher depth); 0 disables the stage and the
+    # train thread device_puts inline, the pre-pipeline behavior
+    device_prefetch: int = 2
+    # dispatches in flight before the loop blocks on the oldest one's
+    # metrics (runtime/metrics.AsyncScalarFetcher window); 1 = the serial
+    # loop. NaN detection lags by at most this many steps.
+    max_in_flight: int = 2
+    # serialize mid-train snapshots on a background thread, from a host
+    # copy taken at the sync point (runtime/checkpoint.AsyncSnapshotWriter)
+    async_snapshot: bool = False
+
+
+_pipeline = PipelineConfig()
+
+
+def pipeline_config() -> PipelineConfig:
+    return _pipeline
+
+
+def set_pipeline_config(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_pipeline, k):
+            raise AttributeError(k)
+        setattr(_pipeline, k, v)
 
 
 # the two libtpu flags async all-reduce fusion needs; checked INDEPENDENTLY

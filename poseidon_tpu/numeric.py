@@ -40,12 +40,39 @@ class Policy:
     # per-op transpose shim this replaces lost 1.9x: its boundary pairs
     # did not cancel across pool/LRN/concat seams.)
     conv_layout: str = "NCHW"
+    # "auto" resolves per-backend at Net construction (resolve_conv_layout):
+    # explicit "nchw"/"nhwc" always win.
     # Space-to-depth stem transform: rewrite few-channel strided convs
     # (AlexNet/GoogLeNet conv1: 3 input channels use 3/128 MXU lanes) as an
     # exact stride-1 conv over s*s-times more channels. Mathematically
     # exact up to float summation order; off by default so golden-value
     # tests compare the direct formulation.
     conv_s2d: bool = False
+
+
+def resolve_conv_layout(layout: str, backend: str = None) -> str:
+    """Resolve a conv_layout choice ("NCHW" | "NHWC" | "auto") against the
+    backend actually running the net.
+
+    "auto" picks the layout the measured A/B favors per backend:
+
+    - **tpu**: NCHW. The NHWC plan wins the HLO-transpose count (exactly
+      the fc-boundary pair) but MEASURED 0.53x on the real v5e
+      (``nhwc_speedup`` in BENCH_r05) — the TPU compiler's own layout
+      assignment beats our forced channels-last plan for these nets, so
+      auto stays NCHW until the bench A/B shows >= 1.0.
+    - **gpu**: NHWC (tensor-core native conv layout).
+    - **cpu** (and anything unknown): NCHW — the Caffe-parity default the
+      golden-value suites run under.
+
+    Explicit "NCHW"/"NHWC" pass through untouched (case-insensitive)."""
+    lay = (layout or "NCHW").upper()
+    if lay != "AUTO":
+        return lay
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    return "NHWC" if backend == "gpu" else "NCHW"
 
 
 _policy = Policy()

@@ -130,6 +130,7 @@ def build_train_step(
     mesh: Mesh,
     comm: Optional[CommConfig] = None,
     donate: bool = True,
+    donate_batch: bool = False,
     dump_blobs: Optional[list] = None,
     scan_steps: Optional[int] = None,
     scan_reuse_batch: bool = False,
@@ -194,7 +195,14 @@ def build_train_step(
     post-accumulation sync routes DENSE layers through the flat parameter
     arena's buckets — ceil(bytes/arena_bucket_mb) collectives — while SFB
     and DENSE_FUSED layers get one dense psum per accumulated leaf; TOPK
-    compression still applies, on the accumulated gradient."""
+    compression still applies, on the accumulated gradient.
+
+    ``donate_batch=True`` additionally donates the batch buffers: with a
+    device-side input prefetch stage (``data.pipeline.DevicePrefetcher``)
+    feeding fresh device arrays every step, donation lets XLA recycle the
+    previous step's batch allocation, so steady-state training allocates
+    no new device batch buffers. Callers that reuse a batch across calls
+    (bench's ``scan_reuse_batch``) must keep the default False."""
     comm = comm or CommConfig()
     comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
@@ -468,7 +476,10 @@ def build_train_step(
             out_specs=(P(), TrainState(P(), err_spec), P()),
             check_vma=False,
         )
-        jitted = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+        argnums = (0, 1) if donate else ()
+        if donate_batch:
+            argnums = argnums + (2,)
+        jitted = jax.jit(sharded, donate_argnums=argnums)
         return TrainStep(
             step=jitted,
             mesh=mesh,
@@ -488,7 +499,10 @@ def build_train_step(
         out_specs=(P(), TrainState(P(), err_spec), P(), batch_spec),
         check_vma=False,
     )
-    jitted = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+    argnums = (0, 1) if donate else ()
+    if donate_batch:
+        argnums = argnums + (2,)
+    jitted = jax.jit(sharded, donate_argnums=argnums)
     if dump_blobs:
         step = jitted
     else:
@@ -585,6 +599,7 @@ def build_ssp_train_step(
     staleness: int,
     comm: Optional[CommConfig] = None,
     input_transform: Optional[Callable] = None,
+    donate_batch: bool = False,
 ):
     """Staleness-s data parallelism (SSP, ssp_consistency_controller.cpp:37-161).
 
@@ -831,7 +846,8 @@ def build_ssp_train_step(
         in_specs=(ssp_spec, batch_spec, P()),
         out_specs=(ssp_spec, P()),
         check_vma=False)
-    jitted = jax.jit(sharded, donate_argnums=(0,))
+    jitted = jax.jit(sharded,
+                     donate_argnums=(0, 1) if donate_batch else (0,))
     return TrainStep(
         step=jitted,
         mesh=mesh,

@@ -24,7 +24,8 @@ test_runtime.test_arena_snapshot_portability).
 from __future__ import annotations
 
 import os
-from typing import Dict, Tuple
+import threading
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +67,39 @@ def _unflatten(flat: Dict[str, np.ndarray]):
     return tree
 
 
+def _gather(tree):
+    """Per-device SSP leaves (and TOPK residuals) are sharded over the data
+    axis; under multi-process they span non-addressable devices, so gather
+    them to every host first — each rank then writes identical bytes."""
+    if jax.process_count() == 1 or not jax.tree_util.tree_leaves(tree):
+        return tree
+    from jax.experimental import multihost_utils
+    return jax.tree_util.tree_map(
+        lambda x: multihost_utils.process_allgather(x, tiled=True)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable else x,
+        tree)
+
+
+def snapshot_paths(prefix: str, state) -> Tuple[str, str]:
+    """(model_path, state_path) the snapshot protocol will produce for this
+    state — shared by the sync writer and the async writer's caller-visible
+    return value."""
+    is_ssp = isinstance(state, SSPState)
+    it = int(state.it if is_ssp else state.solver.it)
+    return (f"{prefix}_iter_{it}.caffemodel",
+            f"{prefix}_iter_{it}.solverstate.npz")
+
+
+def host_state_copy(params, state):
+    """Blocking host copy of (params, state) — THE sync point the async
+    snapshot writer serializes from: sharded leaves are gathered first
+    (np.asarray on a non-addressable array would fail), every leaf lands
+    as numpy, and the state's TrainState/SSPState type is preserved (the
+    pytree re-registers the NamedTuple)."""
+    to_np = lambda tree: jax.tree_util.tree_map(np.asarray, tree)  # noqa: E731
+    return to_np(params), to_np(_gather(state))
+
+
 def snapshot(prefix: str, net: Net, params, state) -> Tuple[str, str]:
     """Write both artifacts atomically (tmp + rename): with replicated state
     every rank writes identical bytes, so even concurrent snapshots to a
@@ -78,8 +112,7 @@ def snapshot(prefix: str, net: Net, params, state) -> Tuple[str, str]:
     is_ssp = isinstance(state, SSPState)
     it = int(state.it if is_ssp else state.solver.it)
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
-    model_path = f"{prefix}_iter_{it}.caffemodel"
-    state_path = f"{prefix}_iter_{it}.solverstate.npz"
+    model_path, state_path = snapshot_paths(prefix, state)
     pid = os.getpid()
 
     # .caffemodel always holds the globally-agreed view: anchor under SSP.
@@ -90,18 +123,7 @@ def snapshot(prefix: str, net: Net, params, state) -> Tuple[str, str]:
                                   net.export_weights(model_params)))
     os.replace(tmp, model_path)
 
-    # Per-device SSP leaves (and TOPK residuals) are sharded over the data
-    # axis; under multi-process they span non-addressable devices, so gather
-    # them to every host first — each rank then writes identical bytes again.
-    def gather(tree):
-        if jax.process_count() == 1 or not jax.tree_util.tree_leaves(tree):
-            return tree
-        from jax.experimental import multihost_utils
-        return jax.tree_util.tree_map(
-            lambda x: multihost_utils.process_allgather(x, tiled=True)
-            if isinstance(x, jax.Array) and not x.is_fully_addressable else x,
-            tree)
-
+    gather = _gather
     arrays = {"iter": np.asarray(it)}
     if is_ssp:
         arrays["kind"] = np.asarray("ssp")
@@ -222,6 +244,62 @@ def coerce_state(params, state, *, staleness: int, n_dev: int, comm=None):
             fresh = init_ssp_state(state.anchor_params, n_dev, comm)
             return state.anchor_params, fresh._replace(it=state.it)
     return params, fix_err(params, state)
+
+
+class AsyncSnapshotWriter:
+    """Snapshot serialization off the training critical path.
+
+    ``submit()`` takes the host copy synchronously (the ONLY sync point —
+    ``host_state_copy`` blocks on the device and gathers sharded leaves,
+    which must happen on the caller thread under multi-process), then
+    hands serialization + the atomic tmp-rename protocol to a background
+    thread running the unmodified ``snapshot()``. At most one write is in
+    flight: a new ``submit`` first joins the previous one, so snapshot
+    cadence can never outrun the disk into unbounded queued host copies.
+
+    Failures are loud, never lost: a write error is re-raised by the next
+    ``submit()``/``wait()``. A torn shutdown (process death mid-write)
+    leaves at worst ``*.tmp.<pid>`` litter — the rename is what creates
+    the real suffix, so a partial file can never shadow a completed
+    artifact; ``sweep_stale_tmp`` collects the litter on the next
+    auto-resume (tests/test_pipeline_overlap.py)."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._last: Optional[Tuple[str, str]] = None
+
+    def submit(self, prefix: str, net: Net, params, state) -> Tuple[str, str]:
+        """Queue one snapshot; returns the (model, state) paths the write
+        will land at. Blocks only for the host copy (and any still-running
+        previous write)."""
+        self.wait()  # one in flight; re-raises a previous failure
+        host_params, host_state = host_state_copy(params, state)
+        paths = snapshot_paths(prefix, host_state)
+
+        def _write():
+            try:
+                self._last = snapshot(prefix, net, host_params, host_state)
+            except BaseException as e:  # noqa: BLE001 — surfaced on join
+                self._error = e
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+        return paths
+
+    def wait(self) -> Optional[Tuple[str, str]]:
+        """Join the in-flight write (if any); re-raise its failure; return
+        the last completed (model, state) paths."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        return self._last
+
+    def close(self) -> None:
+        self.wait()
 
 
 def load_caffemodel(path: str, net: Net, params):

@@ -94,7 +94,10 @@ def _engine_from_args(args, phase_nets=True):
                   staleness=staleness, sfb_auto=args.sfb_auto,
                   steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
                   device_transform=getattr(args, "device_transform", False),
-                  async_ssp=async_cfg)
+                  async_ssp=async_cfg,
+                  device_prefetch=getattr(args, "device_prefetch", None),
+                  max_in_flight=getattr(args, "max_in_flight", None),
+                  async_snapshot=getattr(args, "async_snapshot", None))
 
 
 def cmd_train(args) -> int:
@@ -564,9 +567,11 @@ def cmd_convert_db(args) -> int:
 
 def cmd_extract_features(args) -> int:
     import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
     from ..core.net import Net
     from ..data.pipeline import build_phase_pipelines
     from ..data.workload import Shard
+    from ..parallel import make_mesh
     from ..proto.messages import load_net
     from .checkpoint import load_caffemodel
     from .cluster import init_distributed
@@ -587,8 +592,11 @@ def cmd_extract_features(args) -> int:
         params = load_caffemodel(args.weights, net, params)
     prefix = args.out_prefix if nproc == 1 else \
         f"{args.out_prefix}_client{rank}"
+    # batches land with the train path's batch sharding (engine.py), not
+    # defaulted onto device 0
+    sharding = NamedSharding(make_mesh(), P("data"))
     extract_features(net, params, args.blobs.split(","), pipes[0],
-                     args.num_batches, prefix)
+                     args.num_batches, prefix, sharding=sharding)
     for p in pipes:
         p.close()
     return 0
@@ -708,6 +716,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "(lax.scan): amortizes per-dispatch runtime "
                         "round-trip; falls back to single steps near "
                         "display/test/snapshot boundaries")
+    t.add_argument("--device_prefetch", type=int, default=None,
+                   help="device-side input prefetch depth: a background "
+                        "stage device_puts the next N host batches with "
+                        "the step's batch sharding while the current step "
+                        "runs, and the batch buffers become donated step "
+                        "inputs (no steady-state batch allocations); 0 "
+                        "restores the inline device_put (default: the "
+                        "PipelineConfig policy, 2)")
+    t.add_argument("--max_in_flight", type=int, default=None,
+                   help="bounded in-flight dispatch window: dispatch step "
+                        "k+1 before step k's metrics are read, blocking "
+                        "only when this many dispatches are un-"
+                        "materialized; 1 = the serial loop. Loss display "
+                        "and NaN detection lag by at most this many steps "
+                        "(default: the PipelineConfig policy, 2)")
+    t.add_argument("--async_snapshot", action="store_true", default=None,
+                   help="serialize mid-train snapshots on a background "
+                        "thread (host copy taken at the sync point; the "
+                        "atomic tmp-rename protocol and auto-resume "
+                        "semantics are unchanged; default: the "
+                        "PipelineConfig policy, off)")
     t.add_argument("--profile", type=int, default=0,
                    help="capture an xplane trace over N steps (from step 10)")
     t.add_argument("--device_transform", action="store_true",

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.net import Net
-from ..data.pipeline import BatchPipeline, build_phase_pipelines
+from ..data.pipeline import (BatchPipeline, DevicePrefetcher,
+                             build_phase_pipelines)
 from ..data.workload import Shard
 from ..parallel import (CommConfig, build_eval_step, build_ssp_train_step,
                         build_train_step, init_ssp_state, init_train_state,
@@ -37,9 +38,26 @@ from ..parallel import (CommConfig, build_eval_step, build_ssp_train_step,
 from ..parallel.trainer import TrainStep, comm_error_groups, stack_batches
 from ..proto.messages import NetParameter, SolverParameter, load_net
 from ..solvers.updates import learning_rate
-from .checkpoint import (latest_snapshot, load_caffemodel, restore, snapshot,
-                         sweep_stale_tmp)
-from .metrics import MetricsTable, StatsRegistry, log
+from .checkpoint import (AsyncSnapshotWriter, latest_snapshot,
+                         load_caffemodel, restore, snapshot, sweep_stale_tmp)
+from .metrics import AsyncScalarFetcher, MetricsTable, StatsRegistry, log
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised when a watched training metric (loss) goes non-finite.
+
+    Detection rides the async metrics drain (AsyncScalarFetcher), so the
+    loop learns of the divergence at most ``max_in_flight`` dispatches
+    after the step that produced it; ``iteration`` rewinds the report to
+    the step whose metrics actually diverged."""
+
+    def __init__(self, iteration: int, key: str, value: float):
+        self.iteration = iteration
+        self.key = key
+        self.value = value
+        super().__init__(
+            f"training diverged: {key} = {value} at iteration {iteration} "
+            f"(detected asynchronously, within the in-flight window)")
 
 
 def resolve_nets(sp: SolverParameter):
@@ -80,8 +98,24 @@ class Engine:
         steps_per_dispatch: int = 1,
         device_transform: bool = False,
         async_ssp: Optional[Dict] = None,
+        device_prefetch: Optional[int] = None,
+        max_in_flight: Optional[int] = None,
+        async_snapshot: Optional[bool] = None,
     ):
         self.sp = sp
+        # step-pipeline knobs: explicit args win, else the global policy
+        # (config.PipelineConfig; CLI flags land there or here directly)
+        from ..config import pipeline_config
+        _pc = pipeline_config()
+        self.device_prefetch = int(_pc.device_prefetch
+                                   if device_prefetch is None
+                                   else device_prefetch)
+        self.max_in_flight = max(1, int(_pc.max_in_flight
+                                        if max_in_flight is None
+                                        else max_in_flight))
+        self.async_snapshot = bool(_pc.async_snapshot
+                                   if async_snapshot is None
+                                   else async_snapshot)
         self.mesh = mesh or make_mesh()
         self.n_dev = int(np.prod(list(self.mesh.shape.values())))
         self.comm = comm or CommConfig()
@@ -169,6 +203,31 @@ class Engine:
                 "under SSP staleness", rank=self.rank)
             self._h5_train = []
 
+        # --- step pipeline eligibility ------------------------------------ #
+        # Device-side input prefetch feeds the SINGLE-batch path: the
+        # stacked paths (scan chunking, iter_size micro-batches) assemble
+        # host batches in their own shapes and would desync the shared
+        # pipeline order if a prefetcher were draining the same pipes.
+        self._use_prefetch = (self.device_prefetch > 0
+                              and self.iter_size == 1
+                              and max(1, int(steps_per_dispatch)) == 1)
+        if device_prefetch is not None and self.device_prefetch > 0 and \
+                not self._use_prefetch:
+            # warn only on an EXPLICIT request — the policy default (2)
+            # silently stands down for stacked-batch runs
+            log("WARNING: --device_prefetch disabled (iter_size > 1 or "
+                "steps_per_dispatch > 1 use stacked host batches); the "
+                "stacked transfer already amortizes the host->device "
+                "boundary", rank=self.rank)
+        # with a prefetcher handing the step a FRESH device batch every
+        # iteration, donating the batch buffers lets XLA recycle the
+        # previous step's allocation — steady state allocates no new
+        # device batch buffers. CPU never honors donation (unimplemented)
+        # yet the unhonored aliasing spec measurably slows the call path
+        # (~10% on the 2-core bench box), so donate only where the
+        # allocator actually recycles.
+        donate_batch = self._use_prefetch and jax.default_backend() != "cpu"
+
         # --- compiled steps ---------------------------------------------- #
         if staleness > 0:
             # SSP (ssp_consistency_controller.cpp): each device runs local
@@ -176,7 +235,8 @@ class Engine:
             # of "the params" is the replicated anchor (what the PS holds).
             ssp_ts = build_ssp_train_step(self.train_net, sp, self.mesh,
                                           staleness, self.comm,
-                                          input_transform=self._input_transform)
+                                          input_transform=self._input_transform,
+                                          donate_batch=donate_batch)
             raw_step = ssp_ts.step
 
             def _ssp_step(params, state, batch, rng):
@@ -201,7 +261,7 @@ class Engine:
             self.train_step = build_train_step(
                 self.train_net, sp, self.mesh, self.comm, dump_blobs=dump,
                 input_transform=self._input_transform,
-                iter_size=self.iter_size)
+                iter_size=self.iter_size, donate_batch=donate_batch)
 
         # --- multi-step dispatch (scan chunks) ---------------------------- #
         # K optimizer steps per compiled dispatch: amortizes the runtime's
@@ -256,6 +316,12 @@ class Engine:
         self.test_metrics = [MetricsTable(f"test_{i}")
                              for i in range(len(self.test_nets))]
         self.profile_steps = 0  # set >0 to capture an xplane trace
+        # background snapshot serialization (--async_snapshot): the host
+        # copy is still taken synchronously at the snapshot boundary (THE
+        # sync point), but encode + write + atomic rename leave the loop
+        self._snap_writer = AsyncSnapshotWriter() if self.async_snapshot \
+            else None
+        self._device_feed: Optional[DevicePrefetcher] = None
 
         self._h5_outputs = [
             [(l.lp.hdf5_output_param.file_name, list(l.lp.bottom))
@@ -337,17 +403,11 @@ class Engine:
         return transform
 
     def _next_batch(self, pipes: List[BatchPipeline]):
+        from ..data.pipeline import place_batch
         batch: Dict[str, jax.Array] = {}
-        sharding = self._sample_sharding
-        multihost = jax.process_count() > 1
         for pipe in pipes:
-            host = next(pipe)
-            for k, v in host.items():
-                if multihost:
-                    batch[k] = jax.make_array_from_process_local_data(
-                        sharding, v)
-                else:
-                    batch[k] = jax.device_put(v, sharding)
+            for k, v in next(pipe).items():
+                batch[k] = place_batch(v, self._sample_sharding)
         return batch
 
     def _next_batch_stack(self, pipes: List[BatchPipeline], k: int,
@@ -414,6 +474,12 @@ class Engine:
         if not self.sp.snapshot_prefix:
             return None
         prefix = os.path.join(self.output_dir, self.sp.snapshot_prefix)
+        if self._snap_writer is not None:
+            model, statef = self._snap_writer.submit(
+                prefix, self.train_net, self.params, self.state)
+            log(f"Snapshotting (async) to {model} / {statef}",
+                rank=self.rank)
+            return statef
         model, statef = snapshot(prefix, self.train_net, self.params,
                                  self.state)
         log(f"Snapshotting to {model} / {statef}", rank=self.rank)
@@ -459,31 +525,21 @@ class Engine:
         self.test_metrics[test_id].accumulate(out)
         return out
 
-    @staticmethod
-    def _metric_rows(pending: List[Dict]) -> List[Dict[str, float]]:
-        """Materialize buffered device metrics into one float row per
-        optimizer step. Single-step entries hold scalars; scan-chunk
-        entries hold [K]-stacked arrays and expand to K rows."""
-        rows: List[Dict[str, float]] = []
-        for pm in pending:
-            arrs = {k: np.asarray(v) for k, v in pm.items()}
-            k_steps = max((a.shape[0] for a in arrs.values()
-                           if a.ndim >= 1), default=1)
-            if k_steps == 1 and all(a.ndim == 0 for a in arrs.values()):
-                rows.append({k: float(a) for k, a in arrs.items()})
-            else:
-                for i in range(k_steps):
-                    rows.append({k: float(a[i]) if a.ndim >= 1 else float(a)
-                                 for k, a in arrs.items()})
-        return rows
+    def _check_divergence(self, fetcher: AsyncScalarFetcher) -> None:
+        """Abort on the first non-finite watched metric the async drain has
+        seen. The report names the step that PRODUCED the bad value (the
+        fetcher tags rows by iteration — the rewind), even though the loop
+        has dispatched up to max_in_flight steps past it."""
+        if fetcher.divergence is not None:
+            it, key, value = fetcher.divergence
+            raise TrainingDivergedError(it, key, value)
 
-    def _flush_pending(self, pending: List[Dict]) -> Dict[str, float]:
-        """Materialize buffered device metrics into the metrics table;
-        returns the last step's row."""
-        rows = self._metric_rows(pending)
-        for row in rows:
+    def _absorb(self, rows, last: Dict[str, float]) -> Dict[str, float]:
+        """Feed drained (iter, row) pairs into the metrics window."""
+        for _, row in rows:
             self.metrics.accumulate(row)
-        return rows[-1]
+            last = row
+        return last
 
     def train(self, max_iter: Optional[int] = None) -> Dict[str, float]:
         sp = self.sp
@@ -491,7 +547,15 @@ class Engine:
         it = self.iteration()
         t_start = time.time()
         last: Dict[str, float] = {}
-        pending: List[Dict] = []  # un-materialized device metrics
+        # the dispatch window: device metrics drain to host floats on the
+        # fetcher's thread; put() blocks only when max_in_flight dispatches
+        # are un-materialized, so the loop runs ahead of the device by a
+        # bounded number of steps instead of hard-syncing every iteration
+        fetcher = AsyncScalarFetcher(self.max_in_flight)
+        if self._use_prefetch and self._device_feed is None:
+            self._device_feed = DevicePrefetcher(
+                self.train_pipelines, self._sample_sharding,
+                depth=self.device_prefetch)
         if self._async_cfg is not None and self._async_tier is None:
             from .async_tier import AsyncSSPTier
             self._async_tier = AsyncSSPTier(self.params, **self._async_cfg)
@@ -509,125 +573,164 @@ class Engine:
                 self.test(i)
                 self.test_metrics[i].flush_row(it)
 
-        while it < max_iter:
-            if sp.snapshot and it > 0 and it % sp.snapshot == 0:
-                self.snapshot_now()
-            if self.profile_steps and it == profile_start:
-                jax.profiler.start_trace(
-                    os.path.join(self.output_dir, "profile"))
-                profiling = True
+        try:
+            while it < max_iter:
+                if sp.snapshot and it > 0 and it % sp.snapshot == 0:
+                    # snapshot boundary = hard sync point: every in-flight
+                    # step's metrics must be seen BEFORE persisting params,
+                    # so a NaN that the drainer has not surfaced yet can
+                    # never be snapshotted and then silently auto-resumed
+                    last = self._absorb(fetcher.sync(), last)
+                    self._check_divergence(fetcher)
+                    self.snapshot_now()
+                if self.profile_steps and it == profile_start:
+                    jax.profiler.start_trace(
+                        os.path.join(self.output_dir, "profile"))
+                    profiling = True
 
-            # how many steps may run before the next host-side boundary
-            # (display flush / debug pre-step / test / snapshot / profile);
-            # a full steps_per_dispatch chunk runs as ONE compiled dispatch
-            chunk = 1
-            if self._scan_step is not None:
-                room = max_iter - it
-                if sp.display:
-                    d = sp.display - (it % sp.display)
-                    room = min(room, d - 1 if self._debug_fn else d)
-                if sp.test_interval and self.test_nets:
-                    room = min(room, sp.test_interval -
-                               (it % sp.test_interval))
-                if sp.snapshot:
-                    room = min(room, sp.snapshot - (it % sp.snapshot))
-                if self.profile_steps and \
-                        it < profile_start + self.profile_steps:
-                    # single-step dispatches only until the trace window
-                    # closes; afterwards chunking resumes
-                    room = min(room, profile_start - it) \
-                        if it < profile_start else 1
-                if room >= self.steps_per_dispatch:
-                    chunk = self.steps_per_dispatch
+                # how many steps may run before the next host-side boundary
+                # (display flush / debug pre-step / test / snapshot /
+                # profile); a full steps_per_dispatch chunk runs as ONE
+                # compiled dispatch
+                chunk = 1
+                if self._scan_step is not None:
+                    room = max_iter - it
+                    if sp.display:
+                        d = sp.display - (it % sp.display)
+                        room = min(room, d - 1 if self._debug_fn else d)
+                    if sp.test_interval and self.test_nets:
+                        room = min(room, sp.test_interval -
+                                   (it % sp.test_interval))
+                    if sp.snapshot:
+                        room = min(room, sp.snapshot - (it % sp.snapshot))
+                    if self.profile_steps and \
+                            it < profile_start + self.profile_steps:
+                        # single-step dispatches only until the trace window
+                        # closes; afterwards chunking resumes
+                        room = min(room, profile_start - it) \
+                            if it < profile_start else 1
+                    if room >= self.steps_per_dispatch:
+                        chunk = self.steps_per_dispatch
 
-            if chunk > 1:
-                batch = self._next_batch_stack(
-                    self.train_pipelines, chunk * self.iter_size,
-                    lead_shape=((chunk, self.iter_size)
-                                if self.iter_size > 1 else None))
-                t0 = time.time()
-                # the scan step folds rng by global iteration internally
-                # (solver.it + offset): pass the session rng unfolded so a
-                # chunked run's per-step streams match single-step dispatch
-                self.params, self.state, m = self._scan_step.step(
-                    self.params, self.state, batch, self.rng)
-                it += chunk
-                at_display = bool(sp.display) and it % sp.display == 0
-            else:
-                if self.iter_size > 1:
-                    # one optimizer step = iter_size stacked micro-batches
+                if chunk > 1:
+                    t_in = time.perf_counter()
                     batch = self._next_batch_stack(
-                        self.train_pipelines, self.iter_size,
-                        sharding=self.train_step.batch_sharding)
+                        self.train_pipelines, chunk * self.iter_size,
+                        lead_shape=((chunk, self.iter_size)
+                                    if self.iter_size > 1 else None))
+                    self.stats.add_time("input_stall",
+                                        time.perf_counter() - t_in)
+                    t0 = time.time()
+                    # the scan step folds rng by global iteration internally
+                    # (solver.it + offset): pass the session rng unfolded so
+                    # a chunked run's per-step streams match single-step
+                    # dispatch
+                    self.params, self.state, m = self._scan_step.step(
+                        self.params, self.state, batch, self.rng)
+                    it += chunk
+                    at_display = bool(sp.display) and it % sp.display == 0
                 else:
-                    batch = self._next_batch(self.train_pipelines)
-                at_display = bool(sp.display) and (it + 1) % sp.display == 0
-                if at_display and self._debug_fn:
-                    # BEFORE the step, on the step's own inputs (pre-update
-                    # params, this iteration's rng/batch) — the values
-                    # Caffe's ForwardDebugInfo/UpdateDebugInfo report for
-                    # iteration it+1. Under iter_size the debug pass reads
-                    # the first micro-batch (one representative forward).
-                    dbatch = ({k: v[0] for k, v in batch.items()}
-                              if self.iter_size > 1 else batch)
-                    stats = self._debug_fn(self.params, dbatch,
-                                           jax.random.fold_in(self.rng, it))
-                    for key in sorted(stats):
-                        kind, name = key.split("\x00")
-                        log(f"    [debug] {kind:<5} {name}: "
-                            f"{float(stats[key]):.6g}", rank=self.rank)
-                t0 = time.time()
-                result = self.train_step.step(
-                    self.params, self.state, batch,
-                    jax.random.fold_in(self.rng, it))
-                if self._h5_train:
-                    self.params, self.state, m, dumps = result
-                    self._write_train_h5(dumps)
-                else:
-                    self.params, self.state, m = result
-                it += 1
-            if profiling and it >= profile_start + self.profile_steps:
-                jax.block_until_ready(m["loss"])
+                    t_in = time.perf_counter()
+                    if self.iter_size > 1:
+                        # one optimizer step = iter_size stacked micro-batches
+                        batch = self._next_batch_stack(
+                            self.train_pipelines, self.iter_size,
+                            sharding=self.train_step.batch_sharding)
+                    elif self._device_feed is not None:
+                        # the prefetch stage already placed this batch on
+                        # device with the step's sharding; steady state this
+                        # dequeue is instant and input_stall measures any
+                        # residual starvation
+                        batch = next(self._device_feed)
+                    else:
+                        batch = self._next_batch(self.train_pipelines)
+                    self.stats.add_time("input_stall",
+                                        time.perf_counter() - t_in)
+                    at_display = bool(sp.display) and \
+                        (it + 1) % sp.display == 0
+                    if at_display and self._debug_fn:
+                        # BEFORE the step, on the step's own inputs
+                        # (pre-update params, this iteration's rng/batch) —
+                        # the values Caffe's ForwardDebugInfo/UpdateDebugInfo
+                        # report for iteration it+1. Under iter_size the
+                        # debug pass reads the first micro-batch (one
+                        # representative forward).
+                        dbatch = ({k: v[0] for k, v in batch.items()}
+                                  if self.iter_size > 1 else batch)
+                        stats = self._debug_fn(
+                            self.params, dbatch,
+                            jax.random.fold_in(self.rng, it))
+                        for key in sorted(stats):
+                            kind, name = key.split("\x00")
+                            log(f"    [debug] {kind:<5} {name}: "
+                                f"{float(stats[key]):.6g}", rank=self.rank)
+                    t0 = time.time()
+                    result = self.train_step.step(
+                        self.params, self.state, batch,
+                        jax.random.fold_in(self.rng, it))
+                    if self._h5_train:
+                        self.params, self.state, m, dumps = result
+                        self._write_train_h5(dumps)
+                    else:
+                        self.params, self.state, m = result
+                    it += 1
+                if profiling and it >= profile_start + self.profile_steps:
+                    jax.block_until_ready(m["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    log(f"Wrote profiler trace to "
+                        f"{os.path.join(self.output_dir, 'profile')}",
+                        rank=self.rank)
+                # metrics stay device arrays on this thread: the fetcher's
+                # drainer materializes them to host floats off-thread, and
+                # put() blocks only when max_in_flight dispatches are still
+                # un-materialized — the bounded in-flight dispatch window
+                fetcher.put(it - chunk, m)
+                self._check_divergence(fetcher)
+                self.stats.add("train_iters", chunk)
+                self.stats.add_time("train_step", time.time() - t0)
+                if self._async_tier is not None:
+                    self._async_tier.after_iters(self, chunk)
+
+                # absorb whatever the drainer finished — no display cadence
+                # needed to keep the metrics window bounded
+                last = self._absorb(fetcher.take_drained(), last)
+                if at_display:  # same boundary: it has incremented since
+                    # hard sync: the displayed window must cover every step
+                    # through `it` (the drainer may lag by the in-flight
+                    # window otherwise)
+                    last = self._absorb(fetcher.sync(), last)
+                    self._check_divergence(fetcher)
+                    row = self.metrics.flush_row(it)
+                    lr = float(learning_rate(sp, jnp.asarray(it - 1)))
+                    extras = ", ".join(
+                        f"{k} = {v:.4f}" for k, v in sorted(row.items())
+                        if k not in ("iter", "time"))
+                    log(f"Iteration {it}, lr = {lr:.6g}, {extras}",
+                        rank=self.rank)
+                if sp.test_interval and it % sp.test_interval == 0 and \
+                        self.test_nets:
+                    # test boundary = hard sync point too: never spend a
+                    # full eval sweep on a model a still-draining NaN has
+                    # already poisoned
+                    last = self._absorb(fetcher.sync(), last)
+                    self._check_divergence(fetcher)
+                    for i in range(len(self.test_nets)):
+                        self.test(i)
+                        self.test_metrics[i].flush_row(it)
+
+            # tail iterations past the last display boundary
+            last = self._absorb(fetcher.sync(), last)
+            self._check_divergence(fetcher)
+        finally:
+            self.stats.counters["steps_in_flight"] = round(
+                fetcher.mean_in_flight(), 3)
+            fetcher.close()
+            if profiling:
                 jax.profiler.stop_trace()
-                profiling = False
                 log(f"Wrote profiler trace to "
                     f"{os.path.join(self.output_dir, 'profile')}",
                     rank=self.rank)
-            # keep metrics as device arrays: float() here would block the
-            # host on every step and serialize the async dispatch pipeline;
-            # values materialize only at display boundaries
-            pending.append(m)
-            self.stats.add("train_iters", chunk)
-            self.stats.add_time("train_step", time.time() - t0)
-            if self._async_tier is not None:
-                self._async_tier.after_iters(self, chunk)
-
-            if not sp.display and len(pending) >= 64:
-                # no display cadence configured: flush periodically so the
-                # window never pins unbounded live device buffers
-                last = self._flush_pending(pending)
-                pending = []
-            if at_display:  # same boundary: it has incremented since
-                last = self._flush_pending(pending)
-                pending = []
-                row = self.metrics.flush_row(it)
-                lr = float(learning_rate(sp, jnp.asarray(it - 1)))
-                extras = ", ".join(
-                    f"{k} = {v:.4f}" for k, v in sorted(row.items())
-                    if k not in ("iter", "time"))
-                log(f"Iteration {it}, lr = {lr:.6g}, {extras}", rank=self.rank)
-            if sp.test_interval and it % sp.test_interval == 0 and \
-                    self.test_nets:
-                for i in range(len(self.test_nets)):
-                    self.test(i)
-                    self.test_metrics[i].flush_row(it)
-
-        if pending:  # tail iterations past the last display boundary
-            last = self._flush_pending(pending)
-        if profiling:
-            jax.profiler.stop_trace()
-            log(f"Wrote profiler trace to "
-                f"{os.path.join(self.output_dir, 'profile')}", rank=self.rank)
         if self._async_tier is not None:
             # flush the last clock + fold the final anchor into rank 0's
             # params BEFORE the after-train snapshot, so the snapshot holds
@@ -638,6 +741,10 @@ class Engine:
             self._async_tier = None
         if sp.snapshot_after_train:
             self.snapshot_now()
+        if self._snap_writer is not None:
+            # train() returning means the artifacts exist: join the last
+            # background write (and surface its failure loudly)
+            self._snap_writer.wait()
         self.stats.add_time("train_total", time.time() - t_start)
         self._write_artifacts()
         return last
@@ -702,8 +809,33 @@ class Engine:
         self.stats.dump_yaml(os.path.join(self.output_dir, "stats.yaml"))
 
     def close(self):
+        # close EVERYTHING before surfacing any failure: a snapshot-write
+        # error must not strand the prefetcher/pipeline worker threads,
+        # and an aborted (diverged/interrupted) run must not leak the
+        # async tier's sockets behind the skipped finish() protocol
+        err: Optional[BaseException] = None
+        if self._snap_writer is not None:
+            try:
+                self._snap_writer.close()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err = e
+        if self._async_tier is not None:
+            for closer in (lambda: self._async_tier.client.close(),
+                           lambda: (self._async_tier.service.close()
+                                    if self._async_tier.service else None)):
+                try:
+                    closer()
+                except Exception:  # noqa: BLE001 — teardown best effort
+                    pass
+            self._async_tier = None
+        if self._device_feed is not None:
+            # before the pipelines: the feed's worker consumes them
+            self._device_feed.close()
+            self._device_feed = None
         for p in self.train_pipelines:
             p.close()
         for pipes in self.test_pipelines:
             for p in pipes:
                 p.close()
+        if err is not None:
+            raise err

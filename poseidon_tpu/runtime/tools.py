@@ -123,20 +123,42 @@ def convert_db(src_path: str, out_path: str, out_backend: str = "LMDB") -> int:
 
 def extract_features(net, params, blob_names: List[str], pipeline,
                      num_batches: int, out_prefix: str,
-                     mesh=None) -> List[str]:
+                     sharding=None) -> List[str]:
     """Forward `num_batches` batches, dump named blobs to one LMDB per blob
-    (feature_extractor.cpp:16-139; features keyed by running sample index)."""
+    (feature_extractor.cpp:16-139; features keyed by running sample index).
+
+    ``sharding`` is the batch sharding to place inputs with — the same
+    placement rule the train path uses (``data.pipeline.place_batch``,
+    multi-process aware), so tools-path batches land sharded across the
+    mesh instead of defaulting onto device 0. Batches whose leading dim
+    the sharding cannot split evenly fall back to the pre-sharding
+    unsharded put."""
     import jax
     from ..data.lmdb_reader import LMDBWriter
+    from ..data.pipeline import place_batch
 
     writers = {b: LMDBWriter(f"{out_prefix}_{b.replace('/', '_')}")
                for b in blob_names}
     fwd = jax.jit(lambda p, batch: net.apply(p, batch, train=False,
                                              keep_blobs=True).blobs)
+
+    def _place(v):
+        # multi-process extraction keeps LOCAL placement: each rank
+        # forwards its own record shard and writes its own LMDBs
+        # (feature_extractor.cpp's per-client naming) — assembling a
+        # global array here would hand every rank non-addressable rows
+        # and break the per-client output contract
+        if jax.process_count() > 1:
+            return jax.device_put(v)
+        try:
+            return place_batch(v, sharding)
+        except ValueError:  # batch not divisible by the data axis
+            return jax.device_put(v)
+
     sample = 0
     for _ in range(num_batches):
         host = next(pipeline)
-        batch = {k: jax.device_put(v) for k, v in host.items()}
+        batch = {k: _place(v) for k, v in host.items()}
         blobs = fwd(params, batch)
         n = next(iter(host.values())).shape[0]
         for b in blob_names:

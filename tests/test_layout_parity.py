@@ -430,3 +430,36 @@ def test_s2d_stem_rewrite_parity_nhwc():
     np.testing.assert_allclose(
         np.asarray(jnp.transpose(got_nhwc, (0, 3, 1, 2))),
         np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_conv_layout_auto_resolves_per_backend():
+    """``conv_layout="auto"`` resolves at Net construction: NCHW on TPU
+    (NHWC measured 0.53x on the real v5e in BENCH_r05 despite winning the
+    HLO-transpose count), NHWC on GPU (tensor-core native), NCHW on CPU /
+    unknown backends; explicit overrides pass through untouched."""
+    from poseidon_tpu.numeric import resolve_conv_layout
+
+    assert resolve_conv_layout("auto", backend="tpu") == "NCHW"
+    assert resolve_conv_layout("auto", backend="gpu") == "NHWC"
+    assert resolve_conv_layout("auto", backend="cpu") == "NCHW"
+    assert resolve_conv_layout("auto", backend="something_else") == "NCHW"
+    assert resolve_conv_layout("NHWC", backend="tpu") == "NHWC"
+    assert resolve_conv_layout("nchw", backend="gpu") == "NCHW"
+
+    # a Net built under "auto" lands on this backend's resolved layout
+    # (the suite runs on CPU -> NCHW) and still trains/applies
+    np_ = NetParameter(name="auto_net", layers=[
+        LayerParameter(name="c", type="CONVOLUTION", bottom=["data"],
+                       top=["c"],
+                       convolution_param=ConvolutionParameter(
+                           num_output=4, kernel_size=3)),
+    ], input=["data"], input_dim=[2, 3, 8, 8])
+    net = Net(np_, "TEST", conv_layout="auto")
+    assert net.conv_layout == resolve_conv_layout("auto")
+    assert net.conv_layout in ("NCHW", "NHWC")
+
+    # the ambient policy accepts "auto" too
+    from poseidon_tpu import config
+    with config.policy_scope(conv_layout="auto"):
+        net2 = Net(np_, "TEST")
+        assert net2.conv_layout == resolve_conv_layout("auto")
