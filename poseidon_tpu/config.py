@@ -15,6 +15,7 @@ The fault-tolerance policy (``FaultConfig``) is eager and dependency-free.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 # Numeric-policy names re-exported from poseidon_tpu.numeric via the
@@ -108,6 +109,37 @@ def set_pipeline_config(**kwargs) -> None:
         if not hasattr(_pipeline, k):
             raise AttributeError(k)
         setattr(_pipeline, k, v)
+
+
+@dataclass
+class CompileCacheConfig:
+    """Fast-restart policy (runtime/compile_cache.py): where the
+    persistent XLA compile cache lives and whether AOT-serialized step
+    executables ride alongside it. Empty cache_dir = both layers off —
+    every process start pays full JIT, the pre-elasticity behavior."""
+
+    # persistent XLA compile cache directory ("" = disabled); the AOT
+    # step-executable store lives under <cache_dir>/aot
+    cache_dir: str = ""
+    # serialize/reload the compiled train-step executable itself (skips
+    # tracing AND compilation on a key match; best-effort — any mismatch
+    # falls back to jit + the persistent cache)
+    aot_steps: bool = True
+
+
+_compile_cache = CompileCacheConfig(
+    cache_dir=os.environ.get("POSEIDON_COMPILE_CACHE_DIR", ""))
+
+
+def compile_cache_config() -> CompileCacheConfig:
+    return _compile_cache
+
+
+def set_compile_cache_config(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_compile_cache, k):
+            raise AttributeError(k)
+        setattr(_compile_cache, k, v)
 
 
 # the two libtpu flags async all-reduce fusion needs; checked INDEPENDENTLY

@@ -7,12 +7,20 @@ computes contiguous (client x thread) index ranges over a record count
 (ps/src/ml/include/ml/util/workload_manager.hpp:23-55). Both reduce to a
 shard function over [0, n); this module provides the range math plus an epoch
 permutation so every shard sees a disjoint, reshuffled slice per epoch.
+
+Elastic membership (the async-SSP tier admits/retires workers mid-run)
+keys the assignment by the CURRENT member list instead of a launch-time
+(rank, world): :func:`member_shard` maps a worker id to its position in
+the sorted member list, so a 1 -> 3 -> 2 scale sequence partitions the
+record space cleanly at every membership — for any fixed (members, epoch)
+the shards are disjoint and cover [0, n), and a membership change simply
+re-cuts the same epoch permutation into the new number of ranges.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Iterable, Tuple
 
 import numpy as np
 
@@ -47,6 +55,32 @@ def shard_indices(n: int, shard: Shard, epoch: int = 0,
         perm = np.arange(n)
     begin, end = contiguous_range(n, shard)
     return perm[begin:end]
+
+
+def member_shard(members: Iterable[int], worker: int) -> Shard:
+    """The elastic assignment: worker ``worker``'s shard under the CURRENT
+    member list. Position in the sorted member list is the shard index and
+    the member count is the shard count, so the mapping depends only on
+    the membership SET — every member computes the identical partition
+    with no coordination beyond knowing who is in the fleet."""
+    ms = sorted(set(members))
+    if worker not in ms:
+        raise ValueError(f"worker {worker} not in member list {ms}")
+    return Shard(ms.index(worker), len(ms))
+
+
+def elastic_shard_indices(n: int, worker: int, members: Iterable[int],
+                          epoch: int = 0, shuffle: bool = True,
+                          seed: int = 0) -> np.ndarray:
+    """Indices ``worker`` reads for ``epoch`` under the current member
+    list. Keyed by (members, epoch): the epoch permutation is shared by
+    every member (seeded identically, membership-independent), and the
+    member list only decides how many contiguous ranges it is cut into —
+    so shards are disjoint and cover [0, n) for ANY membership, and a
+    scale event mid-epoch re-cuts the SAME permutation (rows move between
+    workers; none are duplicated or dropped by the re-cut itself)."""
+    return shard_indices(n, member_shard(members, worker), epoch=epoch,
+                         shuffle=shuffle, seed=seed)
 
 
 def sharded_source_path(source: str, shard_index: int,

@@ -59,6 +59,30 @@ routinely, so this tier survives them instead):
 - rejoin: a restarted worker process calls :meth:`AsyncSSPClient.rejoin` —
   pull the anchor, re-seed the local cache from it, resume at the anchor's
   recorded clock for this worker.
+
+Elastic membership (the other half of elasticity — the reference's worker
+set is fixed for the life of a job, docs/distributed-guide.md; preemptible
+capacity grows and shrinks, so this tier's member set does too):
+
+- admit: a worker id OUTSIDE the original ``n_workers`` joins a live job
+  via the ``admit`` RPC (:meth:`AsyncSSPClient.join`). The SERVICE picks
+  the join clock — the rendezvous anchor clock, the minimum applied clock
+  over live members (the clock every survivor's gate has already seen) —
+  and replies with the anchor params + clock table + member list. The
+  joiner seeds its cache from the anchor and pushes its first flush at
+  ``join_clock + 1``; its exactly-once seq high-water mark is initialized
+  at the join clock, so the PUSH dedup extends to the new id with no
+  special cases. ``admit`` of an id that is already a member is idempotent
+  (it degenerates to the rejoin pull), so one code path serves fresh
+  workers, restarts, and true admissions alike.
+- shrink: a deliberate departure (``retire`` RPC, :meth:`AsyncSSPClient.
+  leave`) RETIRES the slot — it leaves the member set entirely, so
+  survivors' gates never wait on it again (eviction merely excludes a
+  failed id; retirement removes it, and only a new ``admit`` brings it
+  back). The retired worker's applied clocks stay in the anchor.
+- every clock-bearing reply (push ack, heartbeat, clocks, pull, admit)
+  carries the CURRENT member list; clients gate over that list, never
+  over a static ``range(n_workers)`` — the SSP bound follows the fleet.
 - permanent failure surfaces: when the reconnect deadline is exhausted the
   sender thread records the error and every subsequent ``push``/``gate``/
   ``refresh`` raises it into the training loop — a run never silently
@@ -213,6 +237,13 @@ class ParamService:
             self.gbase = {w: _tree_copy(zeros) for w in range(n_workers)}
         self.clocks = {w: -1 for w in range(n_workers)}  # applied clocks
         self.n_workers = n_workers
+        # elastic membership: the ACTIVE worker set. Starts as the launch
+        # roster; `admit` grows it mid-run (rendezvous at the anchor
+        # clock), `retire` shrinks it deliberately (the slot leaves every
+        # gate's denominator — eviction only excludes, retirement removes)
+        self.members: set = set(range(n_workers))
+        self.retired: set = set()
+        self.admissions = 0  # mid-run admits of NEW worker ids (telemetry)
         self._lock = threading.Lock()
         self._version = 0
         # telemetry: the widest clock spread ever observed at an apply —
@@ -278,7 +309,7 @@ class ParamService:
         while not self._stop.wait(period):
             now = time.time()
             with self._lock:
-                for w in range(self.n_workers):
+                for w in sorted(self.members):
                     if w in self.failed_workers or w in self.done_workers:
                         continue
                     silent = now - self.last_seen.get(w, now)
@@ -290,6 +321,26 @@ class ParamService:
                              f"{self.liveness_timeout_s:.1f}s); survivors' "
                              f"gates now exclude it")
 
+    def _member_view(self) -> Dict:
+        """The membership snapshot every clock-bearing reply carries
+        (caller holds the lock). ``members`` is the FULL membership (a
+        finished worker is still a member of the job — only `retire`
+        removes a slot), so data assignment keyed on it does not churn
+        when a peer merely finishes; clients exclude ``done`` and
+        ``failed`` from their GATES themselves (a finished worker's
+        frozen clock must not wedge a straggler's last gate, and a dead
+        one must not deadlock survivors)."""
+        return {"clocks": dict(self.clocks),
+                "members": sorted(self.members),
+                "failed": sorted(self.failed_workers),
+                "done": sorted(self.done_workers)}
+
+    def _live_clocks(self) -> List[int]:
+        """Applied clocks of gate-relevant members (caller holds lock)."""
+        return [c for w, c in self.clocks.items()
+                if w in self.members and w not in self.failed_workers
+                and w not in self.done_workers]
+
     def _touch(self, worker: int) -> None:
         """Record liveness; any activity from an evicted worker rejoins it
         (its clock resumes where the anchor last applied it)."""
@@ -300,6 +351,40 @@ class ParamService:
                 self.rejoins += 1
                 _log(f"ParamService: worker {worker} rejoined "
                      f"(clock {self.clocks.get(worker, -1)})")
+
+    def _admit_locked(self, w: int) -> int:
+        """Admit worker ``w`` at the rendezvous anchor clock (caller holds
+        the lock). The join clock is the minimum applied clock over live
+        members — the clock every survivor's gate has already seen, so a
+        joiner never appears ahead of work it did not do and holds the
+        fleet back by at most one gate window. Idempotent for existing
+        members (degenerates to the rejoin pull: resume at the applied
+        clock). A RE-admitted id (previously retired/evicted) resumes past
+        its own historical clock/seq high-water mark, so the exactly-once
+        dedup can never swallow its post-readmission flushes."""
+        if w in self.members:
+            return self.clocks.get(w, -1)
+        live = self._live_clocks()
+        join = min(live) if live else -1
+        # a returning id must resume PAST everything it ever flushed
+        join = max(join, self.clocks.get(w, -1), self.applied_seq.get(w, -1))
+        self.members.add(w)
+        self.retired.discard(w)
+        self.failed_workers.discard(w)
+        self.done_workers.discard(w)
+        self.clocks[w] = join
+        self.applied_seq[w] = max(self.applied_seq.get(w, -1), join)
+        self.last_seen[w] = time.time()
+        if self.server_logic == "adarevision":
+            # the admit reply carries the anchor snapshot: the joiner's
+            # first gradients build on it, exactly like a PULL re-base
+            self.gbase[w] = _tree_copy(self.gsum)
+        self.admissions += 1
+        self.n_workers = max(self.n_workers, len(self.members))
+        self._version += 1
+        _log(f"ParamService: admitted worker {w} at join clock {join} "
+             f"({len(self.members)} members)")
+        return join
 
     def _serve(self, conn: socket.socket) -> None:
         if self.auth_token is not None:
@@ -360,24 +445,20 @@ class ParamService:
                                 self.clocks[w] = max(
                                     self.clocks.get(w, -1), msg["clock"])
                                 self._version += 1
-                                cs = [c for ww, c in self.clocks.items()
-                                      if ww not in self.failed_workers]
+                                cs = self._live_clocks()
                                 if cs and all(c >= 0 for c in cs):
                                     self.max_spread = max(
                                         self.max_spread, max(cs) - min(cs))
                             ack = {"ok": True, "dup": dup,
-                                   "clocks": dict(self.clocks),
-                                   "failed": sorted(self.failed_workers)}
+                                   **self._member_view()}
                         _send_msg(conn, ack)
                     elif kind == "heartbeat":
                         # liveness already recorded by _touch above; the
                         # reply piggybacks the clock vector so idle workers
                         # see evictions/progress without an extra RPC
                         with self._lock:
-                            clocks = dict(self.clocks)
-                            failed = sorted(self.failed_workers)
-                        _send_msg(conn, {"ok": True, "clocks": clocks,
-                                         "failed": failed})
+                            view = self._member_view()
+                        _send_msg(conn, {"ok": True, **view})
                     elif kind == "pull":
                         # copy under the lock, serialize/send OUTSIDE it —
                         # a slow client socket must not stall concurrent
@@ -385,23 +466,44 @@ class ParamService:
                         # door)
                         with self._lock:
                             snap = _tree_copy(self.anchor)
-                            clocks = dict(self.clocks)
-                            done = sorted(self.done_workers)
-                            failed = sorted(self.failed_workers)
+                            view = self._member_view()
                             version = self._version
                             if self.server_logic == "adarevision" and \
                                     worker is not None:
                                 # the read re-bases this worker's backlog:
                                 # its next gradients build on THIS snapshot
                                 self.gbase[worker] = _tree_copy(self.gsum)
-                        _send_msg(conn, {"anchor": snap, "clocks": clocks,
-                                         "done": done, "failed": failed,
-                                         "version": version})
+                        _send_msg(conn, {"anchor": snap, "version": version,
+                                         **view})
+                    elif kind == "admit":
+                        w = msg["worker"]
+                        with self._lock:
+                            snap = _tree_copy(self.anchor)
+                            join = self._admit_locked(w)
+                            view = self._member_view()
+                            version = self._version
+                        _send_msg(conn, {"anchor": snap, "join_clock": join,
+                                         "version": version, **view})
+                    elif kind == "retire":
+                        # deliberate scale-down: the slot leaves the member
+                        # set entirely — survivors' gates never wait on it,
+                        # no liveness timeout involved. Applied clocks stay
+                        # in the anchor; only `admit` brings the id back.
+                        w = msg["worker"]
+                        with self._lock:
+                            if w in self.members:
+                                self.members.discard(w)
+                                self.retired.add(w)
+                                self.failed_workers.discard(w)
+                                _log(f"ParamService: worker {w} retired "
+                                     f"(clock {self.clocks.get(w, -1)}); "
+                                     f"{len(self.members)} members remain")
+                            view = self._member_view()
+                        _send_msg(conn, {"ok": True, **view})
                     elif kind == "clocks":
                         with self._lock:
-                            clocks = dict(self.clocks)
-                            failed = sorted(self.failed_workers)
-                        _send_msg(conn, {"clocks": clocks, "failed": failed})
+                            view = self._member_view()
+                        _send_msg(conn, view)
                     elif kind == "done":
                         # a worker finished its run (NOT a barrier:
                         # stragglers keep training; the driver polls
@@ -437,7 +539,11 @@ class ParamService:
             if registered and worker is not None:
                 with self._lock:
                     self._conn_counts[worker] -= 1
-                    if abnormal and worker not in self.done_workers and \
+                    # only MEMBERS can fail: a retired slot already left
+                    # every gate, and a joiner that died before its admit
+                    # landed was never gated on in the first place
+                    if abnormal and worker in self.members and \
+                            worker not in self.done_workers and \
                             self._conn_counts[worker] <= 0 and \
                             worker not in self.failed_workers:
                         self.failed_workers.add(worker)
@@ -522,6 +628,15 @@ class AsyncSSPClient:
         self._pending_lock = threading.Lock()
         self.clocks: Dict[int, int] = {}
         self.failed: set = set()   # peers the service declared dead
+        self.done: set = set()     # peers that finished their run
+        # the CURRENT member set, replaced by every clock-bearing reply —
+        # gates follow the fleet as it grows/shrinks, never a static
+        # range(n_workers). Seeded with the launch roster (a joiner's seed
+        # is replaced by the admit reply before its first gate). Done
+        # workers STAY members (data assignment keys on membership and
+        # must not churn when a peer merely finishes) — gates exclude
+        # them via ``done``.
+        self.members: set = set(range(self.n_workers))
         self.clock = -1          # last flushed clock
         self._acked_clock = -1   # last clock the server acknowledged
         self.blocked_s = 0.0     # cumulative gate wait (telemetry)
@@ -571,8 +686,19 @@ class AsyncSSPClient:
         failed mid-``body`` is discarded, never installed half-used."""
         from ..runtime.retry import retry_with_backoff
 
+        counted = False
+
         def attempt() -> Dict:
+            nonlocal counted
             sk = self._dial_once()
+            # count this recovery EPISODE (once, not per dial) the moment
+            # a channel is re-established — BEFORE body runs: the replay
+            # inside body has externally observable effects (acked clocks,
+            # the service's anchor), and a drain() caller observing them
+            # must also observe the reconnect counter
+            if not counted:
+                self.reconnects += 1
+                counted = True
             try:
                 out = body(sk)
             except BaseException:
@@ -587,13 +713,11 @@ class AsyncSSPClient:
                 pass
             return out
 
-        out = retry_with_backoff(
+        return retry_with_backoff(
             attempt, deadline=self.reconnect_deadline_s,
             base=self.backoff_base_s, cap=self.backoff_cap_s,
             rng=self._rng, retry_on=(OSError, EOFError),
             should_stop=self._stop.is_set)
-        self.reconnects += 1
-        return out
 
     def _recover_push(self, msg: Optional[Dict]) -> Dict:
         """Reconnect the push channel and replay every un-acked flush in
@@ -635,9 +759,18 @@ class AsyncSSPClient:
                  f"({type(e).__name__}: {e}); reconnecting")
             ack = self._recover_push(msg)
         if isinstance(ack, dict) and "clocks" in ack:
-            self.clocks = ack["clocks"]
-            self.failed = set(ack.get("failed", ()))
+            self._absorb_view(ack)
         return ack
+
+    def _absorb_view(self, resp: Dict) -> None:
+        """Adopt a reply's membership snapshot (clock table, member list,
+        failed/done sets) — the client's entire view of the fleet."""
+        self.clocks = resp["clocks"]
+        self.failed = set(resp.get("failed", ()))
+        if "members" in resp:
+            self.members = set(resp["members"])
+        if "done" in resp:
+            self.done = set(resp["done"])
 
     def _pull_rpc(self, msg: Dict) -> Dict:
         """One RPC on the pull channel (training thread only), recovering a
@@ -735,11 +868,15 @@ class AsyncSSPClient:
     def _min_other_clock(self) -> int:
         """A peer we have not heard from yet counts as clock -1 (nothing
         applied), NOT as caught up — otherwise the gate is unenforced
-        until the first ack/refresh arrives. FAILED peers are excluded:
-        a dead worker must not deadlock the survivors' gates (elasticity;
-        the reference would abort the whole job here)."""
-        others = [self.clocks.get(w, -1) for w in range(self.n_workers)
-                  if w != self.worker and w not in self.failed]
+        until the first ack/refresh arrives. The gate runs over the
+        CURRENT member set (admissions join it, retirements leave it);
+        FAILED and DONE peers are excluded: a dead or departed worker
+        must not deadlock the survivors' gates, and a finished worker's
+        frozen clock must not wedge a straggler's last window
+        (elasticity; the reference would abort the whole job here)."""
+        others = [self.clocks.get(w, -1) for w in sorted(self.members)
+                  if w != self.worker and w not in self.failed
+                  and w not in self.done]
         return min(others) if others else self.clock
 
     def gate(self, clock: int, poll_s: float = 0.01,
@@ -766,8 +903,7 @@ class AsyncSSPClient:
                     f"have {self.clocks} (a peer died and eviction is "
                     f"disabled?)")
             resp = self._pull_rpc({"kind": "clocks"})
-            self.clocks = resp["clocks"]
-            self.failed = set(resp.get("failed", ()))
+            self._absorb_view(resp)
             time.sleep(poll_s)
         waited = time.time() - t0
         self.blocked_s += waited
@@ -787,8 +923,7 @@ class AsyncSSPClient:
         if self.server_logic == "adarevision":
             self._drain()
         snap = self._pull_rpc({"kind": "pull"})
-        self.clocks = snap["clocks"]
-        self.failed = set(snap.get("failed", ()))
+        self._absorb_view(snap)
         applied = self.clocks.get(self.worker, -1)
         cache = snap["anchor"]
         with self._pending_lock:
@@ -817,14 +952,43 @@ class AsyncSSPClient:
         Clears the (empty, for a fresh process) local oplog and returns
         (cache, clock_vector); training resumes at ``self.clock + 1``."""
         snap = self._pull_rpc({"kind": "pull"})
-        self.clocks = snap["clocks"]
-        self.failed = set(snap.get("failed", ()))
+        self._absorb_view(snap)
         applied = self.clocks.get(self.worker, -1)
         self.clock = applied
         self._acked_clock = applied
         with self._pending_lock:
             self._pending = []
         return snap["anchor"], dict(self.clocks)
+
+    def join(self) -> Tuple[Dict, Dict[int, int]]:
+        """Elastic join: rendezvous with a live job via the ``admit`` RPC.
+        The service picks the join clock (the anchor clock — min applied
+        clock over live members) and hands back the anchor + clock table +
+        member list; this client seeds its cache from the anchor and
+        resumes flushing at ``join_clock + 1``. For an id that is already
+        a member this degenerates to :meth:`rejoin` (resume at the applied
+        clock), so the engine tier calls ONE method for fresh workers,
+        restarts, and true mid-run admissions alike. Returns
+        (cache, clock_vector)."""
+        snap = self._pull_rpc({"kind": "admit", "worker": self.worker})
+        self._absorb_view(snap)
+        join = int(snap.get("join_clock",
+                            self.clocks.get(self.worker, -1)))
+        self.clock = join
+        self._acked_clock = join
+        with self._pending_lock:
+            self._pending = []
+        return snap["anchor"], dict(self.clocks)
+
+    def leave(self) -> None:
+        """Deliberate scale-down: drain every flushed clock (the retire
+        must not overtake a delta still in flight), then retire this
+        worker's slot — survivors' gates stop waiting on it immediately,
+        with no liveness timeout involved."""
+        self._drain()
+        resp = self._pull_rpc({"kind": "retire", "worker": self.worker})
+        if isinstance(resp, dict) and "clocks" in resp:
+            self._absorb_view(resp)
 
     def mark_done(self) -> None:
         """Tell the service this worker's run is complete (not a barrier)."""
@@ -833,18 +997,27 @@ class AsyncSSPClient:
         self._drain()
         self._pull_rpc({"kind": "done", "worker": self.worker})
 
-    def wait_all_done(self, n_workers: int,
+    def wait_all_done(self, n_workers: Optional[int] = None,
                       timeout_s: float = 300.0) -> Tuple[set, set]:
         """Poll until every worker reported done OR was declared failed
-        (driver-side, rank 0). Returns (done, failed) so the caller can
-        SURFACE a lossy run — elasticity keeps the job alive, it must
-        never keep a partial result quiet."""
+        (driver-side, rank 0). ``n_workers=None`` waits on the CURRENT
+        member set instead of a fixed count — under elastic membership
+        the launch-time roster is stale by construction (admitted workers
+        must be waited for, retired slots must not be). Returns
+        (done, failed) so the caller can SURFACE a lossy run — elasticity
+        keeps the job alive, it must never keep a partial result quiet."""
         t0 = time.time()
         while True:
             snap = self._pull_rpc({"kind": "pull"})
             done = set(snap.get("done", ()))
             failed = set(snap.get("failed", ()))
-            if len(done | failed) >= n_workers:
+            if n_workers is None:
+                # finished when every member is accounted done or failed
+                # (retired slots already left the member list)
+                active = set(snap.get("members", ())) - failed - done
+                if not active:
+                    return done, failed
+            elif len(done | failed) >= n_workers:
                 return done, failed
             if time.time() - t0 > timeout_s:
                 raise TimeoutError(f"only {sorted(done)} finished "
@@ -888,6 +1061,8 @@ def run_async_ssp_worker(
     server_logic: str = "inc",
     init_step: float = 0.1,
     rejoin: bool = False,
+    join: bool = False,
+    retire_at_clock: Optional[int] = None,
     client_opts: Optional[Dict] = None,
 ) -> Dict:
     """Drive one worker through ``n_clocks`` flush clocks.
@@ -905,9 +1080,14 @@ def run_async_ssp_worker(
 
     ``rejoin=True`` is the restart path: seed the cache from the service
     anchor and resume at the anchor's recorded clock for this worker
-    (``params`` is then only a shape/typing fallback). ``client_opts``
-    forwards fault-tolerance knobs (heartbeat_s, reconnect_deadline_s,
-    backoff_*) to :class:`AsyncSSPClient`.
+    (``params`` is then only a shape/typing fallback). ``join=True`` is
+    the ELASTIC path: a worker id outside the launch roster rendezvous
+    with the live job via the admit RPC and trains from the service's
+    join clock. ``retire_at_clock`` scales DOWN: after flushing that
+    clock the worker drains, retires its slot (survivors' gates stop
+    waiting on it), and returns early. ``client_opts`` forwards
+    fault-tolerance knobs (heartbeat_s, reconnect_deadline_s, backoff_*)
+    to :class:`AsyncSSPClient`.
 
     This driver owns only the DCN-tier exchange: gate -> step(s) -> push ->
     refresh. ``slow_s`` injects per-clock straggler delay (test harness).
@@ -922,7 +1102,11 @@ def run_async_ssp_worker(
     adarev = server_logic == "adarevision"
     losses = []
     start_clock = 0
-    if rejoin:
+    retired = False
+    if join:
+        cache, _ = cli.join()
+        start_clock = cli.clock + 1
+    elif rejoin:
         cache, _ = cli.rejoin()
         start_clock = cli.clock + 1
     else:
@@ -953,13 +1137,19 @@ def run_async_ssp_worker(
                                              clock * sync_every + k)
                 losses.append(float(loss))
                 cli.push(_tree_sub(cache, before))
+            if retire_at_clock is not None and clock >= retire_at_clock:
+                cli.leave()
+                retired = True
+                break
             if (clock + 1) % refresh_every == 0:
                 cache, _ = cli.refresh()
         wall = time.time() - t_start
-        cli.mark_done()
+        if not retired:
+            cli.mark_done()
         return {"params": cache, "losses": losses,
                 "blocked_s": cli.blocked_s, "gate_blocks": cli.gate_blocks,
                 "wall_s": wall, "final_clock": cli.clock,
-                "reconnects": cli.reconnects, "start_clock": start_clock}
+                "reconnects": cli.reconnects, "start_clock": start_clock,
+                "retired": retired}
     finally:
         cli.close()

@@ -18,22 +18,18 @@ where workers share nothing but the server connection); the CLI skips
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..data.workload import Shard, member_shard
 from ..parallel.async_ssp import AsyncSSPClient, ParamService
+# canonical home is the (jax-free) cluster control plane; re-exported here
+# because the engine and the existing tests import it from this module
+from .cluster import env_world, is_elastic_joiner  # noqa: F401
 from .metrics import log
-
-
-def env_world() -> Tuple[int, int, Optional[str]]:
-    """(rank, n_procs, coordinator) from the launcher env contract."""
-    return (int(os.environ.get("POSEIDON_PROC_ID", "0")),
-            int(os.environ.get("POSEIDON_NUM_PROCS", "1")),
-            os.environ.get("POSEIDON_COORDINATOR"))
 
 
 def _to_host(tree: Dict) -> Dict:
@@ -76,37 +72,84 @@ class AsyncSSPTier:
         self.service = None
         if self.rank == 0:
             # only the service seed needs the host copy of params — every
-            # rank's own view (_prev/resume_cache) comes from rejoin()'s
+            # rank's own view (_prev/resume_cache) comes from join()'s
             # anchor pull below. None knobs resolve to the global
             # FaultConfig inside the service/client (config.fault_config())
             self.service = ParamService(
                 _to_host(params), n_workers=self.n_procs, host=host,
                 port=port, liveness_timeout_s=liveness_timeout_s)
+            # an ephemeral bind (service_port=0) resolves here: dial what
+            # the service actually got, not the 0 placeholder
+            port = self.service.port
         self.client = AsyncSSPClient(
             self.rank, (host, port), staleness, n_workers=self.n_procs,
             heartbeat_s=heartbeat_s,
             reconnect_deadline_s=reconnect_deadline_s)
-        # restart-aware join: if the service already holds an applied clock
-        # for this worker (a previous incarnation pushed before dying), the
-        # push-seq stream MUST resume past it — a fresh client restarting
-        # at seq 0 would have every post-restart flush swallowed by the
-        # exactly-once dedup. rejoin() also hands back the anchor, which
-        # seeds the cache for restarted AND fresh workers alike (everyone
-        # starts from the same rank-0 view, the reference's init
-        # broadcast); Engine.train adopts it via ``resume_cache``.
-        cache, clocks = self.client.rejoin()
+        # ONE join path for every process biography (join() == the admit
+        # RPC, idempotent for existing members):
+        # - fresh launch-roster worker: admit is a no-op pull, clock -1;
+        # - restart of a known worker: the service already holds an
+        #   applied clock for it, so the push-seq stream resumes PAST the
+        #   exactly-once high-water mark (a client naively restarting at
+        #   seq 0 would have every post-restart flush swallowed by dedup);
+        # - elastic joiner (rank >= launch roster): the service ADMITS it
+        #   at the rendezvous anchor clock and every member's gate/data
+        #   shard re-keys to the grown member list.
+        # join() also hands back the anchor, which seeds the cache for all
+        # three (everyone starts from the same rank-0 view, the
+        # reference's init broadcast); Engine.train adopts it via
+        # ``resume_cache``.
+        cache, clocks = self.client.join()
         applied = clocks.get(self.rank, -1)
-        if applied >= 0:
+        if is_elastic_joiner(self.rank, self.n_procs):
+            # printed from THIS process regardless of rank (log() is
+            # rank-0-only by default): the joiner's operator-visible
+            # evidence that the rendezvous landed is this line
+            log(f"async-SSP tier: rank {self.rank} ADMITTED into the live "
+                f"job at join clock {applied} (members "
+                f"{sorted(self.client.members)})")
+        elif applied >= 0:
             log(f"async-SSP tier: rank {self.rank} rejoined at clock "
                 f"{applied}; push stream resumes at {applied + 1}",
                 rank=self.rank)
         self._prev = cache
         self.resume_cache = cache
         self._iters_since = 0
+        self._members: Tuple[int, ...] = tuple(sorted(self.client.members))
         self._t0 = time.time()
-        log(f"async-SSP tier: {self.n_procs} workers, staleness "
+        log(f"async-SSP tier: {len(self._members)} members, staleness "
             f"{staleness}, flush every {self.sync_every} iter(s), service "
             f"{host}:{port}", rank=self.rank)
+
+    # ------------------------------------------------------------------ #
+    def data_shard(self) -> Shard:
+        """This worker's record-space shard under the CURRENT member list
+        (data/workload.member_shard keyed by membership, not launch
+        rank/world)."""
+        members = set(self.client.members) | {self.rank}
+        return member_shard(members, self.rank)
+
+    def sync_membership(self, engine) -> bool:
+        """Reshard the engine's data assignment if membership changed
+        since the last look. Returns True on a change. Called at tier
+        creation (a joiner's Engine built its pipelines with a placeholder
+        shard) and after every flush (admissions/retirements/evictions
+        land within one clock of the service learning about them)."""
+        mem = tuple(sorted(set(self.client.members) | {self.rank}))
+        if mem == self._members:
+            return False
+        old, self._members = self._members, mem
+        log(f"async-SSP tier: membership changed {list(old)} -> "
+            f"{list(mem)}; resharding data assignment", rank=self.rank)
+        if engine is not None and hasattr(engine, "reshard_data"):
+            engine.reshard_data(member_shard(mem, self.rank))
+        return True
+
+    def membership_counters(self) -> Dict[str, float]:
+        """Membership churn telemetry for the engine's periodic display
+        and stats.yaml (runtime/comm_stats.membership_counters)."""
+        from .comm_stats import membership_counters
+        return membership_counters(service=self.service, client=self.client)
 
     # ------------------------------------------------------------------ #
     def after_iters(self, engine, n_iters: int) -> None:
@@ -150,6 +193,10 @@ class AsyncSSPTier:
                    else self.first_gate_timeout_s)
         self._gated_once = True
         self.client.gate(clock + 1, timeout_s=timeout)
+        # the refresh/gate above refreshed the member view: fold any
+        # admission/retirement/eviction into the data assignment now, at
+        # the clock boundary (never mid-dispatch)
+        self.sync_membership(engine)
 
     def finish(self, engine) -> Dict[str, float]:
         # flush the residual delta of any iterations past the last
@@ -163,11 +210,15 @@ class AsyncSSPTier:
                "async_final_clock": float(self.client.clock),
                "async_reconnects": float(self.client.reconnects)}
         if self.service is not None:
-            # poll (not barrier) until the stragglers flush their last clock
-            done, failed = self.client.wait_all_done(self.n_procs)
+            # poll (not barrier) until the stragglers flush their last
+            # clock; None = the CURRENT member set, which under elastic
+            # membership may have grown past (or shrunk below) the
+            # launch-time n_procs
+            done, failed = self.client.wait_all_done(None)
             out["async_max_spread"] = float(self.service.max_spread)
             out["async_evictions"] = float(self.service.evictions)
             out["async_rejoins"] = float(self.service.rejoins)
+            out["async_admissions"] = float(self.service.admissions)
             if failed:
                 # elasticity keeps the job alive; it must never keep the
                 # loss quiet — the failed workers' un-flushed updates are

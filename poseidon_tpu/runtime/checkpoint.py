@@ -289,12 +289,27 @@ class AsyncSnapshotWriter:
 
     def wait(self) -> Optional[Tuple[str, str]]:
         """Join the in-flight write (if any); re-raise its failure; return
-        the last completed (model, state) paths."""
+        the last completed (model, state) paths.
+
+        Failure surfacing contract (pinned by
+        test_pipeline_overlap.test_async_snapshot_failure_aborts_at_next_
+        sync_boundary): the training loop calls this at every snapshot
+        boundary (submit's join) and at end-of-train, so a background
+        write that died aborts the run AT THE NEXT SYNC BOUNDARY with the
+        original exception — never a silent pass that leaves auto-resume
+        pointing at a snapshot that does not exist."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
+            # name the failed artifact BEFORE re-raising: the exception
+            # type is the writer's own (a disk error stays a disk error),
+            # the context says which snapshot is missing because of it
+            from .metrics import log
+            log(f"async snapshot write FAILED "
+                f"({type(err).__name__}: {err}); the snapshot it was "
+                f"writing does not exist — aborting at this sync boundary")
             raise err
         return self._last
 

@@ -100,8 +100,30 @@ def _engine_from_args(args, phase_nets=True):
                   async_snapshot=getattr(args, "async_snapshot", None))
 
 
+def _enable_compile_cache_from_args(args) -> None:
+    """Stage the fast-restart layers (persistent XLA compile cache + AOT
+    step store) before any program is compiled. Shared by train/serve/
+    bench_serve; empty --compile_cache_dir leaves both off."""
+    from .. import config
+    # the flag wins; the POSEIDON_COMPILE_CACHE_DIR env default (seeded
+    # into CompileCacheConfig at import) covers launcher-managed fleets
+    cache_dir = (getattr(args, "compile_cache_dir", "")
+                 or config.compile_cache_config().cache_dir)
+    if not cache_dir:
+        return
+    from .compile_cache import enable_compile_cache
+    resolved = enable_compile_cache(cache_dir)
+    config.set_compile_cache_config(
+        cache_dir=resolved,
+        aot_steps=getattr(args, "aot_steps", "true") == "true")
+    from .metrics import log
+    log(f"compile cache: persistent XLA cache at {resolved} "
+        f"(aot_steps={getattr(args, 'aot_steps', 'true')})")
+
+
 def cmd_train(args) -> int:
     from .cluster import init_distributed
+    _enable_compile_cache_from_args(args)
     if args.bf16:
         from .. import config
         config.set_perf_policy()
@@ -416,6 +438,10 @@ def cmd_serve(args) -> int:
     from ..serving.server import InferenceServer
     from .metrics import log
 
+    # a serving replica's bucket warm-up is the same cold-start bill the
+    # training tier pays: the persistent cache turns a restarted replica's
+    # AOT bucket compiles into disk reads
+    _enable_compile_cache_from_args(args)
     watch = args.watch
     if watch == "auto":
         # derive the snapshot prefix from the weights path:
@@ -514,6 +540,7 @@ def cmd_bench_serve(args) -> int:
     (p50/p99/throughput + shed/fill telemetry)."""
     import json
 
+    _enable_compile_cache_from_args(args)
     executor = _build_serving_executor(args.model, args.weights, args.buckets)
     result, stats = run_serving_bench(
         executor, args.requests, args.concurrency, args.batch,
@@ -737,6 +764,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "atomic tmp-rename protocol and auto-resume "
                         "semantics are unchanged; default: the "
                         "PipelineConfig policy, off)")
+    t.add_argument("--compile_cache_dir", default="",
+                   help="fast restart: persistent XLA compile cache "
+                        "directory (every backend compile becomes a disk "
+                        "hit on restart) plus an AOT step-executable store "
+                        "under <dir>/aot keyed by (model, shapes, mesh) — "
+                        "a matching restart skips trace AND compile. "
+                        "Empty = off (full JIT per start). Env default: "
+                        "POSEIDON_COMPILE_CACHE_DIR")
+    t.add_argument("--aot_steps", default="true", choices=["true", "false"],
+                   help="with --compile_cache_dir: also serialize/reload "
+                        "the compiled train-step executable itself "
+                        "(best-effort; false keeps only the XLA cache)")
     t.add_argument("--profile", type=int, default=0,
                    help="capture an xplane trace over N steps (from step 10)")
     t.add_argument("--device_transform", action="store_true",
@@ -808,6 +847,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="default per-request deadline (0 = none)")
     sv.add_argument("--poll_s", type=float, default=1.0,
                     help="hot-reload watch cadence")
+    sv.add_argument("--compile_cache_dir", default="",
+                    help="persistent XLA compile cache: a restarted "
+                         "replica's bucket warm-up compiles become disk "
+                         "reads (same flag as train; empty = off)")
     sv.set_defaults(fn=cmd_serve)
 
     bs = sub.add_parser(
@@ -826,6 +869,7 @@ def build_parser() -> argparse.ArgumentParser:
     bs.add_argument("--max_delay_ms", type=float, default=5.0)
     bs.add_argument("--max_queue", type=int, default=64)
     bs.add_argument("--deadline_ms", type=float, default=0.0)
+    bs.add_argument("--compile_cache_dir", default="")
     bs.set_defaults(fn=cmd_bench_serve)
 
     ci = sub.add_parser("convert_imageset", help="image list -> LMDB")

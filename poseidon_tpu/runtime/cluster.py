@@ -21,7 +21,7 @@ from __future__ import annotations
 import os
 import random
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from .retry import retry_with_backoff
 
@@ -29,6 +29,30 @@ from .retry import retry_with_backoff
 # before giving up (env-overridable for tests and slow pod bring-up)
 _RENDEZVOUS_DEADLINE_S = float(
     os.environ.get("POSEIDON_RENDEZVOUS_DEADLINE_S", "60"))
+
+
+def env_world() -> Tuple[int, int, Optional[str]]:
+    """(rank, n_procs, coordinator) from the launcher env contract
+    (POSEIDON_PROC_ID / POSEIDON_NUM_PROCS / POSEIDON_COORDINATOR).
+
+    Elastic contract: under ``--async_ssp`` the roster is a STARTING
+    point, not a bound — a process launched with ``POSEIDON_PROC_ID >=
+    POSEIDON_NUM_PROCS`` is an elastic JOINER: it dials the same
+    coordinator, and the async tier admits it into the live job at the
+    service's rendezvous anchor clock (no relaunch, no new hostfile).
+    The canonical home is here (jax-free, like the rest of the control
+    plane) so socket-tier processes can read the contract without paying
+    the jax import."""
+    return (int(os.environ.get("POSEIDON_PROC_ID", "0")),
+            int(os.environ.get("POSEIDON_NUM_PROCS", "1")),
+            os.environ.get("POSEIDON_COORDINATOR"))
+
+
+def is_elastic_joiner(rank: int, n_procs: int) -> bool:
+    """True when this process is joining a live async-SSP job from outside
+    the launch roster (the POSEIDON_PROC_ID >= POSEIDON_NUM_PROCS
+    convention above)."""
+    return rank >= n_procs
 
 
 @dataclass(frozen=True)

@@ -185,3 +185,37 @@ def comm_summary(table: Dict[str, Dict],
         out["est_comm_fraction_if_unoverlapped"] = round(
             min(1.0, est_ms / measured_step_ms), 4)
     return out
+
+
+# --------------------------------------------------------------------------- #
+# elastic-membership telemetry (the async-SSP tier's churn counters)
+# --------------------------------------------------------------------------- #
+
+def membership_counters(service=None, client=None) -> Dict[str, float]:
+    """The async tier's membership-churn counters, normalized for the
+    engine's periodic display and stats.yaml — churn must be visible
+    without log-grepping. ``service`` (the rank-0 ParamService) carries
+    the authoritative admissions/evictions/rejoins counters; every other
+    rank reports its client-side view (member count, failed peers,
+    reconnects). Either argument may be None."""
+    out: Dict[str, float] = {}
+    if service is not None:
+        # full membership: a finished worker is still a member (only
+        # retire removes a slot), matching the data-assignment key
+        out["members"] = float(len(service.members))
+        out["admissions"] = float(service.admissions)
+        out["evictions"] = float(service.evictions)
+        out["rejoins"] = float(service.rejoins)
+        out["failed"] = float(len(service.failed_workers))
+        out["retired"] = float(len(service.retired))
+    elif client is not None:
+        out["members"] = float(len(client.members))
+        out["failed"] = float(len(client.failed))
+        out["reconnects"] = float(client.reconnects)
+    return out
+
+
+def format_membership(counters: Dict[str, float]) -> str:
+    """One display line: ``members = 3, admissions = 1, ...`` (ints — the
+    counters are counts; float is just the stats-registry convention)."""
+    return ", ".join(f"{k} = {int(v)}" for k, v in sorted(counters.items()))

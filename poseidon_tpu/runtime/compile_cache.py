@@ -1,0 +1,177 @@
+"""Fast restart: persistent compile cache + AOT-serialized step executables.
+
+Elasticity is only cheap if (re)starting a process is cheap, and today a
+(re)start pays full JIT — multi-minute on GoogLeNet (it is why the async
+tier's FIRST-clock gate needed a generously scaled timeout). Two layers
+attack that, both keyed so a restarted-or-new worker with the same job
+config hits them:
+
+1. **Persistent XLA compile cache** (``jax.experimental.compilation_cache``
+   riding the ``jax_compilation_cache_dir`` config): every XLA compile is
+   content-addressed into ``cache_dir``; a restart re-traces but the
+   multi-minute backend compile becomes a disk read. Wired through train
+   AND serve (``--compile_cache_dir``), because a serving replica's bucket
+   warm-up is the same cold-start bill.
+
+2. **AOT step-executable store** (``jax.experimental.serialize_executable``):
+   the compiled train-step executable itself, serialized under
+   ``<cache_dir>/aot/`` keyed by (model, shapes, mesh, backend, policy).
+   A restart that matches the key skips tracing AND compilation — the
+   engine loads the executable and dispatches it directly (building on the
+   abstract-topology lower/compile flow of ``scripts/aot_tpu_check.py``,
+   but serialized for the REAL local topology and reloaded across process
+   boundaries).
+
+Layer 2 is strictly best-effort: any mismatch (jax version, device kind,
+donation flags, numeric policy — all folded into the key) or
+deserialization failure falls back to the jit path, which layer 1 still
+makes fast. Nothing here is load-bearing for numerics: the executable IS
+the jit-compiled program, byte-identified by its lowering.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+__all__ = ["enable_compile_cache", "cache_entries", "step_key",
+           "save_step_executable", "load_step_executable", "aot_entries"]
+
+
+def enable_compile_cache(cache_dir: str,
+                         min_compile_time_s: float = 0.0) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir`` (created
+    if missing). ``min_compile_time_s=0`` caches every program — the
+    tier-1/CPU default, where even sub-second compiles are worth a disk
+    hit; raise it on TPU if tiny-program churn ever matters. Returns the
+    resolved absolute path. Must run before the programs it should cache
+    are compiled (already-compiled programs in this process stay in the
+    in-memory jit cache either way)."""
+    import jax
+
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_s))
+    try:
+        # cache even tiny programs (the knob exists from jax 0.4.16 on;
+        # -1 disables the entry-size floor)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 — older jax: floor simply stays
+        pass
+    try:
+        # the cache object memoizes its first initialization: a process
+        # that already compiled something (with NO cache configured) must
+        # reset it or the new dir is silently ignored
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — fresh process: nothing to reset
+        pass
+    return cache_dir
+
+
+def cache_entries(cache_dir: str) -> int:
+    """How many compiled programs the persistent cache holds (the ``-atime``
+    sidecar files jax writes per entry are not counted)."""
+    try:
+        return sum(1 for n in os.listdir(cache_dir) if n.endswith("-cache"))
+    except OSError:
+        return 0
+
+
+# --------------------------------------------------------------------------- #
+# AOT step-executable store
+# --------------------------------------------------------------------------- #
+
+def _canon(obj: Any) -> Any:
+    """JSON-stable canonicalization for key parts (tuples -> lists, dict
+    keys sorted by json, numpy dtypes -> str)."""
+    if isinstance(obj, dict):
+        return {str(k): _canon(v) for k, v in sorted(obj.items(),
+                                                     key=lambda kv: str(kv[0]))}
+    if isinstance(obj, (list, tuple)):
+        return [_canon(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return str(obj)
+
+
+def step_key(**parts: Any) -> str:
+    """Content key for a serialized step executable. Callers fold in
+    everything that changes the compiled program: model name, param
+    shapes, batch shapes/dtypes, mesh axes/shape, backend + device kind,
+    jax version, donation flags, numeric policy. Same parts -> same key on
+    a restarted process; ANY drift -> clean miss (never a stale load)."""
+    blob = json.dumps(_canon(parts), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+def _aot_dir(cache_dir: str) -> str:
+    return os.path.join(cache_dir, "aot")
+
+
+def _aot_path(cache_dir: str, key: str) -> str:
+    return os.path.join(_aot_dir(cache_dir), f"step_{key}.aotexec")
+
+
+def aot_entries(cache_dir: str) -> int:
+    try:
+        return sum(1 for n in os.listdir(_aot_dir(cache_dir))
+                   if n.endswith(".aotexec"))
+    except OSError:
+        return 0
+
+
+def save_step_executable(cache_dir: str, key: str, compiled) -> Optional[str]:
+    """Serialize a jax Compiled object under the AOT store (atomic tmp +
+    rename — a torn write can never shadow a good entry). Returns the
+    entry path, or None when serialization is unsupported for this
+    program/backend (best-effort by design)."""
+    from jax.experimental.serialize_executable import serialize
+
+    try:
+        payload = pickle.dumps(serialize(compiled))
+    except Exception as e:  # noqa: BLE001 — fall back to the compile cache
+        from .metrics import log
+        log(f"compile_cache: step executable not serializable "
+            f"({type(e).__name__}: {e}); persistent cache still applies")
+        return None
+    path = _aot_path(cache_dir, key)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
+def load_step_executable(cache_dir: str, key: str):
+    """Reload a serialized step executable; None on miss or ANY failure
+    (a stale/foreign entry must degrade to a recompile, never an abort).
+    The returned object is directly callable with the original call
+    signature."""
+    path = _aot_path(cache_dir, key)
+    if not os.path.exists(path):
+        return None
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    try:
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.loads(f.read())
+        return deserialize_and_load(payload, in_tree, out_tree)
+    except Exception as e:  # noqa: BLE001 — miss, not abort
+        from .metrics import log
+        log(f"compile_cache: failed to reload AOT step {key} "
+            f"({type(e).__name__}: {e}); recompiling")
+        return None
+
+
+def describe(cache_dir: str) -> Dict[str, int]:
+    """Telemetry: entry counts for stats/bench output."""
+    return {"xla_cache_entries": cache_entries(cache_dir),
+            "aot_step_entries": aot_entries(cache_dir)}

@@ -135,6 +135,13 @@ class Engine:
             from .async_tier import env_world
             self.rank, self.world, _ = env_world()
         self.memory_data = memory_data
+        # data assignment: launch-time (rank, world) for the fixed-world
+        # tiers; the async tier re-keys it by the CURRENT member list via
+        # reshard_data (an elastic joiner's rank sits OUTSIDE the launch
+        # world, so it builds with the whole-range placeholder and the
+        # tier reshards it at join, before the first batch is consumed)
+        self._data_shard = (Shard(self.rank, self.world)
+                            if self.rank < self.world else Shard(0, 1))
         # uint8 ingest + on-device (x - mean) * scale (the TPU-native split
         # of DataTransformer): train pipelines ship quarter-width bytes and
         # the normalization fuses into the compiled step (sync and SSP).
@@ -158,6 +165,7 @@ class Engine:
         train_param, test_params = resolve_nets(sp)
 
         # --- data pipelines for the train net ---------------------------- #
+        self._train_param = train_param  # retained: reshard_data rebuilds
         self.train_pipelines, train_shapes = self._build_pipelines(
             train_param, "TRAIN")
         self.train_net = Net(train_param, "TRAIN", source_shapes=train_shapes)
@@ -227,6 +235,7 @@ class Engine:
         # (~10% on the 2-core bench box), so donate only where the
         # allocator actually recycles.
         donate_batch = self._use_prefetch and jax.default_backend() != "cpu"
+        self._donate_batch = donate_batch
 
         # --- compiled steps ---------------------------------------------- #
         if staleness > 0:
@@ -323,6 +332,22 @@ class Engine:
             else None
         self._device_feed: Optional[DevicePrefetcher] = None
 
+        # fast restart (runtime/compile_cache.py): when a compile-cache
+        # dir is configured, the single-step hot path resolves through the
+        # AOT step-executable store on first dispatch — a restarted-or-new
+        # worker whose (model, shapes, mesh, policy) key matches skips
+        # tracing AND compilation entirely; a miss compiles once,
+        # serializes for the next incarnation, and still rides the
+        # persistent XLA cache. SSP local-step and HDF5-dump steps keep
+        # the jit path (different call signatures).
+        from ..config import compile_cache_config
+        _ccc = compile_cache_config()
+        self._aot_exec = None
+        self._aot_failed = False
+        self._aot_enabled = (bool(_ccc.cache_dir) and _ccc.aot_steps
+                             and staleness == 0 and not self._h5_train
+                             and self.iter_size == 1)
+
         self._h5_outputs = [
             [(l.lp.hdf5_output_param.file_name, list(l.lp.bottom))
              for l in net.layers if l.TYPE == "HDF5_OUTPUT"]
@@ -365,14 +390,43 @@ class Engine:
             self._debug_fn = jax.jit(_debug)
 
     # ---------------------------------------------------------------- #
-    def _build_pipelines(self, net_param: NetParameter, phase: str):
+    def _build_pipelines(self, net_param: NetParameter, phase: str,
+                         shard: Optional[Shard] = None):
         # Each host produces only its addressable devices' rows; the pipeline
         # shards the record space across hosts (shared_file_system-style).
         return build_phase_pipelines(
             net_param, phase, batch_multiplier=jax.local_device_count(),
-            shard=Shard(self.rank, self.world),
+            shard=shard if shard is not None else self._data_shard,
             memory_data=self.memory_data,
             device_transform=(self._device_transform and phase == "TRAIN"))
+
+    def reshard_data(self, shard: Shard) -> bool:
+        """Re-key the TRAIN data assignment (elastic membership: the async
+        tier calls this when the member list changes, with the shard from
+        ``data/workload.member_shard``). Rebuilds the train pipelines —
+        and the device prefetcher consuming them — against the new
+        contiguous range; test pipelines keep the launch shard (eval is a
+        fixed-world sweep). No-op when the shard is unchanged."""
+        if shard == self._data_shard:
+            return False
+        old = self._data_shard
+        if self._device_feed is not None:
+            # the feed's worker thread consumes the pipelines being torn
+            # down; stop it first, recreate it against the new ones below
+            self._device_feed.close()
+            self._device_feed = None
+        for p in self.train_pipelines:
+            p.close()
+        self.train_pipelines, _ = self._build_pipelines(
+            self._train_param, "TRAIN", shard=shard)
+        self._data_shard = shard
+        if self._use_prefetch:
+            self._device_feed = DevicePrefetcher(
+                self.train_pipelines, self._sample_sharding,
+                depth=self.device_prefetch)
+        log(f"resharded data assignment: shard {old.index}/{old.count} -> "
+            f"{shard.index}/{shard.count}", rank=self.rank)
+        return True
 
     def _make_input_transform(self):
         """The device half of the uint8 ingest split: per data-layer
@@ -423,6 +477,80 @@ class Engine:
         if sharding is None:
             sharding = self._scan_step.batch_sharding
         return stack_batches(rows, sharding, lead_shape=lead_shape)
+
+    # ---------------------------------------------------------------- #
+    def _dispatch_train_step(self, batch, rng):
+        """One single-step dispatch, through the AOT warm-start path when
+        configured (resolution is lazy: the store key needs the concrete
+        batch shapes, which exist only once the first batch is drawn)."""
+        if self._aot_enabled and self._aot_exec is None \
+                and not self._aot_failed:
+            self._resolve_aot_step(batch, rng)
+        if self._aot_exec is not None:
+            # the lowerable's raw signature carries the (empty — AOT is
+            # disabled under HDF5_OUTPUT) dump slot; keep the step()
+            # wrapper's 3-tuple contract
+            out = self._aot_exec(self.params, self.state, batch, rng)
+            return out[:3] if isinstance(out, tuple) and len(out) > 3 \
+                else out
+        return self.train_step.step(self.params, self.state, batch, rng)
+
+    def _resolve_aot_step(self, batch, rng) -> None:
+        """Load — or compile + serialize — the step executable for this
+        exact (model, shapes, mesh, backend, policy) key. Best-effort:
+        any failure pins the jit path for the rest of the run (which the
+        persistent compile cache still accelerates)."""
+        from ..config import compile_cache_config, policy
+        from .compile_cache import (load_step_executable,
+                                    save_step_executable, step_key)
+        try:
+            cfg = compile_cache_config()
+            key = step_key(
+                kind="train_step",
+                model=self.train_net.name or "net",
+                params={l: {p: (list(v.shape), str(v.dtype))
+                            for p, v in ps.items()}
+                        for l, ps in self.params.items()},
+                batch={k: (list(v.shape), str(v.dtype))
+                       for k, v in batch.items()},
+                mesh={k: int(v) for k, v in self.mesh.shape.items()},
+                backend=jax.default_backend(),
+                device_kind=jax.devices()[0].device_kind,
+                n_devices=self.n_dev,
+                jax_version=jax.__version__,
+                numeric_policy=str(policy()),
+                conv_layout=self.train_net.conv_layout,
+                # compile-RELEVANT solver fields only: max_iter/display/
+                # snapshot cadence never reach the traced program, and
+                # folding them in would defeat the warm start for the
+                # standard resume-and-train-longer flow
+                solver={k: str(getattr(self.sp, k, None)) for k in (
+                    "solver_type", "base_lr", "lr_policy", "gamma",
+                    "power", "stepsize", "stepvalue", "momentum",
+                    "momentum2", "weight_decay", "regularization_type",
+                    "delta", "clip_gradients", "iter_size",
+                    "random_seed")},
+                comm=str(self.comm),
+                donate_batch=self._donate_batch)
+            exec_ = load_step_executable(cfg.cache_dir, key)
+            if exec_ is None:
+                low = self.train_step.lowerable or self.train_step.step
+                compiled = low.lower(self.params, self.state, batch,
+                                     rng).compile()
+                save_step_executable(cfg.cache_dir, key, compiled)
+                exec_ = compiled
+                log(f"aot warm start: compiled + serialized train step "
+                    f"(key {key[:12]}); next start of this config skips "
+                    f"trace+compile", rank=self.rank)
+            else:
+                log(f"aot warm start: loaded serialized train step "
+                    f"(key {key[:12]}) — trace and compile skipped",
+                    rank=self.rank)
+            self._aot_exec = exec_
+        except Exception as e:  # noqa: BLE001 — warm start is best-effort
+            self._aot_failed = True
+            log(f"aot warm start unavailable ({type(e).__name__}: {e}); "
+                f"using the jit path", rank=self.rank)
 
     # ---------------------------------------------------------------- #
     def iteration(self) -> int:
@@ -561,9 +689,15 @@ class Engine:
             self._async_tier = AsyncSSPTier(self.params, **self._async_cfg)
             # every worker starts from the service anchor: rank 0's view on
             # a fresh run, the surviving anchor (all applied clocks) when
-            # this process is a preemption restart rejoining mid-job
+            # this process is a preemption restart rejoining mid-job, and
+            # the join-clock anchor for an elastic joiner admitted into a
+            # live job
             self.params = jax.device_put(self._async_tier.resume_cache,
                                          self.train_step.replicated)
+            # key the data assignment by the member list the join revealed
+            # (a joiner built its pipelines with the placeholder shard;
+            # everyone else no-ops unless the fleet already changed)
+            self.reshard_data(self._async_tier.data_shard())
         # profiler window: skip a couple of warmup/compile steps
         profile_start = it + 2
         profiling = False
@@ -665,9 +799,8 @@ class Engine:
                             log(f"    [debug] {kind:<5} {name}: "
                                 f"{float(stats[key]):.6g}", rank=self.rank)
                     t0 = time.time()
-                    result = self.train_step.step(
-                        self.params, self.state, batch,
-                        jax.random.fold_in(self.rng, it))
+                    result = self._dispatch_train_step(
+                        batch, jax.random.fold_in(self.rng, it))
                     if self._h5_train:
                         self.params, self.state, m, dumps = result
                         self._write_train_h5(dumps)
@@ -708,6 +841,14 @@ class Engine:
                         if k not in ("iter", "time"))
                     log(f"Iteration {it}, lr = {lr:.6g}, {extras}",
                         rank=self.rank)
+                    if self._async_tier is not None:
+                        # membership churn rides the display cadence, so
+                        # admissions/evictions are visible without
+                        # log-grepping (comm_stats.membership_counters)
+                        from .comm_stats import format_membership
+                        log("    [membership] " + format_membership(
+                            self._async_tier.membership_counters()),
+                            rank=self.rank)
                 if sp.test_interval and it % sp.test_interval == 0 and \
                         self.test_nets:
                     # test boundary = hard sync point too: never spend a

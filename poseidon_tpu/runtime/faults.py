@@ -25,6 +25,11 @@ Rules (:class:`FaultRule`):
                  client->server traffic, 0 = on first activity), named for
                  intent: a hard mid-run partition.
 
+Any rule can be made ONE-SHOT with ``nth=N``: it fires on exactly the Nth
+connection that passes its other filters, then expires — the targeting
+mode the elasticity chaos suite uses to kill a specific handshake (e.g.
+"sever precisely the admit rendezvous, not the dials before it").
+
 Runtime controls: :meth:`FaultProxy.sever_all` hard-drops every live
 connection at once (worker preemption / network partition mid-run);
 :meth:`FaultProxy.refuse_new` black-holes reconnect attempts (the
@@ -46,18 +51,32 @@ __all__ = ["FaultRule", "FaultProxy"]
 class FaultRule:
     """One deterministic fault. ``conn`` matches the nth accepted
     connection (0-based; None = every connection); ``max_conns`` expires
-    the rule after it has matched that many connections (None = never)."""
+    the rule after it has matched that many connections (None = never).
+
+    ``nth`` is the ONE-SHOT targeting mode: the rule fires on exactly the
+    Nth (0-based) connection that passes its other filters, then expires
+    forever — connections before the Nth pass through untouched and do
+    not consume the rule. ``conn`` can only address an absolute accepted
+    index and ``max_conns`` only a leading prefix, so neither can express
+    "kill specifically the 3rd connection from now" — e.g. the rejoin or
+    admit handshake of a worker whose earlier dials already consumed
+    unpredictable indices. ``nth`` can."""
 
     action: str = "sever"          # drop | delay | truncate | sever
     conn: Optional[int] = None
     after_bytes: int = 0           # truncate/sever: client->server budget
     delay_s: float = 0.0           # delay: added latency per chunk
     max_conns: Optional[int] = None
+    nth: Optional[int] = None      # one-shot: fire on the Nth match only
     hits: int = field(default=0, repr=False)  # connections matched so far
+    seen: int = field(default=0, repr=False)  # candidates examined (nth)
+    expired: bool = field(default=False, repr=False)  # nth fired already
 
     def __post_init__(self):
         if self.action not in ("drop", "delay", "truncate", "sever"):
             raise ValueError(f"unknown fault action {self.action!r}")
+        if self.nth is not None and self.nth < 0:
+            raise ValueError(f"nth must be >= 0, got {self.nth}")
 
 
 class FaultProxy:
@@ -118,10 +137,21 @@ class FaultProxy:
     def _match(self, idx: int) -> Optional[FaultRule]:
         with self._lock:
             for r in self._rules:
+                if r.expired:
+                    continue
                 if r.conn is not None and r.conn != idx:
                     continue
                 if r.max_conns is not None and r.hits >= r.max_conns:
                     continue
+                if r.nth is not None:
+                    # one-shot targeting: count candidates deterministically;
+                    # only the Nth consumes (and expires) the rule — earlier
+                    # candidates pass through and may match LATER rules
+                    k = r.seen
+                    r.seen += 1
+                    if k != r.nth:
+                        continue
+                    r.expired = True
                 r.hits += 1
                 return r
         return None

@@ -302,7 +302,7 @@ random_seed: 7
         capture=True)
     logs = [b.decode() for b in raw_logs]
     assert rc == 0, logs[0][-2000:] + logs[1][-2000:]
-    assert "async-SSP tier: 2 workers" in logs[0]
+    assert "async-SSP tier: 2 members" in logs[0]
     assert "Iteration 10" in logs[0]
     # chunked dispatch (steps_per_dispatch=3): one flush clock per
     # dispatch, so the final clock is dispatch-count-1 (display/test
@@ -359,7 +359,7 @@ random_seed: 11
         capture=True)
     logs = [b.decode() for b in raw_logs]
     assert rc == 0, logs[0][-2000:] + logs[1][-2000:]
-    assert "async-SSP tier: 2 workers" in logs[0]
+    assert "async-SSP tier: 2 members" in logs[0]
     assert "Iteration 8" in logs[0] or "Iteration 4" in logs[0]
 
 

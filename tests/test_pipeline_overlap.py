@@ -330,6 +330,38 @@ def test_torn_async_writer_shutdown_leaves_no_partial_files(tmp_path,
     w.close()
 
 
+def test_async_snapshot_failure_aborts_at_next_sync_boundary(tmp_path,
+                                                             monkeypatch):
+    """A failed BACKGROUND snapshot write must abort the run at the next
+    sync boundary (the following snapshot cadence point, or end-of-train)
+    with the writer's original error — never train to completion as if
+    the snapshot existed, which would leave auto-resume pointing at
+    nothing. Pinned for the elasticity story: preemptible fleets lean on
+    snapshots + rejoin, so a silently-lost snapshot is a silently-lost
+    worker contribution on the next restart."""
+    from poseidon_tpu.runtime import checkpoint as ckpt
+    from poseidon_tpu.runtime.engine import Engine
+
+    def dying_savez(f, **arrays):
+        raise IOError("disk vanished mid-write")
+
+    monkeypatch.setattr(ckpt.np, "savez", dying_savez)
+    sp = _solver(max_iter=30, snapshot=5, snapshot_prefix="snap/die")
+    eng = Engine(sp, memory_data=_memory_data(),
+                 output_dir=str(tmp_path), async_snapshot=True)
+    try:
+        with pytest.raises(IOError, match="disk vanished"):
+            eng.train()
+        # the abort landed at the NEXT snapshot boundary after the failed
+        # iter-5 write (iter 10's submit joins the dead iter-5 thread) —
+        # not at end-of-train 20 iterations later
+        assert eng.iteration() <= 10, (
+            f"failure surfaced only at iteration {eng.iteration()}; the "
+            f"iter-10 sync boundary should have re-raised it")
+    finally:
+        eng.close()
+
+
 # --------------------------------------------------------------------------- #
 # device prefetcher: failure propagation + fault-injection interop
 # --------------------------------------------------------------------------- #
