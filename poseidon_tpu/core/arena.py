@@ -117,16 +117,21 @@ class ArenaLayout:
 
     def pack(self, tree: Tree) -> jax.Array:
         """Per-leaf tree -> flat 1-D buffer, in slot (DWBP) order."""
-        return jnp.concatenate(
-            [self._leaf(tree, s).reshape(-1) for s in self.slots])
+        # named scopes here and below: xplane events from the pack/unpack
+        # copies attribute to the arena phase, not to the residual row
+        # (runtime/attribution.py joins these names back from op metadata)
+        with jax.named_scope("arena_pack"):
+            return jnp.concatenate(
+                [self._leaf(tree, s).reshape(-1) for s in self.slots])
 
     def unpack(self, flat: jax.Array) -> Tree:
         """Flat buffer -> per-leaf tree (static slices + reshapes)."""
-        out: Tree = {}
-        for s in self.slots:
-            leaf = lax.slice(flat, (s.offset,), (s.offset + s.size,))
-            out.setdefault(s.layer, {})[s.pname] = leaf.reshape(s.shape)
-        return out
+        with jax.named_scope("arena_unpack"):
+            out: Tree = {}
+            for s in self.slots:
+                leaf = lax.slice(flat, (s.offset,), (s.offset + s.size,))
+                out.setdefault(s.layer, {})[s.pname] = leaf.reshape(s.shape)
+            return out
 
     def split_buckets(self, flat: jax.Array) -> Tuple[jax.Array, ...]:
         return tuple(lax.slice(flat, (lo,), (hi,))
@@ -167,17 +172,19 @@ class ArenaLayout:
             layout = self
 
             def fwd_impl(bufs):
-                out: Tree = {}
-                for s, pieces in zip(layout.slots, layout._slot_pieces):
-                    parts = [lax.slice(bufs[bi],
-                                       (lo - layout.bucket_ranges[bi][0],),
-                                       (hi - layout.bucket_ranges[bi][0],))
-                             for bi, lo, hi in pieces]
-                    leaf = parts[0] if len(parts) == 1 else \
-                        jnp.concatenate(parts)
-                    out.setdefault(s.layer, {})[s.pname] = \
-                        leaf.reshape(s.shape)
-                return out
+                with jax.named_scope("arena_views"):
+                    out: Tree = {}
+                    for s, pieces in zip(layout.slots, layout._slot_pieces):
+                        parts = [lax.slice(
+                            bufs[bi],
+                            (lo - layout.bucket_ranges[bi][0],),
+                            (hi - layout.bucket_ranges[bi][0],))
+                            for bi, lo, hi in pieces]
+                        leaf = parts[0] if len(parts) == 1 else \
+                            jnp.concatenate(parts)
+                        out.setdefault(s.layer, {})[s.pname] = \
+                            leaf.reshape(s.shape)
+                    return out
 
             @jax.custom_vjp
             def views_fn(*bufs):
@@ -187,17 +194,20 @@ class ArenaLayout:
                 return fwd_impl(bufs), None
 
             def views_bwd(_, ct):
-                outs = []
-                for pieces in layout._bucket_pieces:
-                    parts = []
-                    for si, lo, hi in pieces:
-                        s = layout.slots[si]
-                        leaf_ct = ct[s.layer][s.pname].reshape(-1)
-                        parts.append(lax.slice(leaf_ct, (lo - s.offset,),
-                                               (hi - s.offset,)))
-                    outs.append(parts[0] if len(parts) == 1 else
-                                jnp.concatenate(parts))
-                return tuple(outs)
+                # "arena_grads": the per-bucket cotangent assembly — the
+                # copies between backward matmuls and the bucketed psums
+                with jax.named_scope("arena_grads"):
+                    outs = []
+                    for pieces in layout._bucket_pieces:
+                        parts = []
+                        for si, lo, hi in pieces:
+                            s = layout.slots[si]
+                            leaf_ct = ct[s.layer][s.pname].reshape(-1)
+                            parts.append(lax.slice(leaf_ct, (lo - s.offset,),
+                                                   (hi - s.offset,)))
+                        outs.append(parts[0] if len(parts) == 1 else
+                                    jnp.concatenate(parts))
+                    return tuple(outs)
 
             views_fn.defvjp(views_fwd, views_bwd)
             self._views = views_fn

@@ -383,12 +383,17 @@ class Net:
         outputs: Dict[str, jax.Array] = {}
         for layer in self.layers:
             lp = layer.lp
-            bottoms = [bottom_in(b, layer.run_layout) for b in lp.bottom]
             # layer-scoped HLO metadata: xplane trace events carry the layer
             # name, so one profiled step attributes device time per layer
             # (no per-layer recompiles — the `time --per_layer` alternative
-            # on compile-expensive runtimes)
+            # on compile-expensive runtimes); autodiff preserves the scope,
+            # so backward ops attribute too (transpose(jvp(<name>)) paths —
+            # runtime/attribution.py joins both back). Bottom layout
+            # conversions sit INSIDE the scope: a boundary transpose bills
+            # to the layer that demanded it, not to the residual row.
             with jax.named_scope(layer.name):
+                bottoms = [bottom_in(b, layer.run_layout)
+                           for b in lp.bottom]
                 tops = layer.apply(
                     self._layer_params(params, layer) if layer.params else {},
                     bottoms, ctx)

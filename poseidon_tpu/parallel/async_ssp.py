@@ -118,6 +118,10 @@ import numpy as np
 from ..proto.wire import (AuthError, FrameError, client_handshake,
                           recv_frame as _recv_msg, send_frame as _send_msg,
                           server_handshake)
+# span instrumentation for the tier's wait points (push enqueue, anchor
+# pulls, SSP gate, elastic admit); jax-free like everything else here, and
+# a no-op until the engine enables the recorder under --trace_out
+from ..runtime.spans import recorder as _spans
 
 __all__ = ["ParamService", "AsyncSSPClient", "run_async_ssp_worker",
            "FrameError", "AuthError"]
@@ -838,11 +842,12 @@ class AsyncSSPClient:
         """Flush one clock's accumulated update. Returns the new clock.
         NEVER blocks on the network — the sender thread owns the socket."""
         self._check_alive()
-        self.clock += 1
-        with self._pending_lock:
-            self._pending.append((self.clock, _tree_copy(delta)))
-        self._q.put((self.clock, delta))
-        return self.clock
+        with _spans.span("async_push", "async", {"worker": self.worker}):
+            self.clock += 1
+            with self._pending_lock:
+                self._pending.append((self.clock, _tree_copy(delta)))
+            self._q.put((self.clock, delta))
+            return self.clock
 
     def _drain(self, timeout_s: Optional[float] = None) -> None:
         """Wait until the server ACKED every flushed clock (not merely
@@ -895,16 +900,18 @@ class AsyncSSPClient:
             return 0.0
         t0 = time.time()
         self.gate_blocks += 1
-        while self._min_other_clock() < need:
-            self._check_alive()
-            if time.time() - t0 > timeout_s:
-                raise TimeoutError(
-                    f"worker {self.worker} stuck at gate: need clock {need}, "
-                    f"have {self.clocks} (a peer died and eviction is "
-                    f"disabled?)")
-            resp = self._pull_rpc({"kind": "clocks"})
-            self._absorb_view(resp)
-            time.sleep(poll_s)
+        with _spans.span("async_gate", "async",
+                         {"worker": self.worker, "clock": clock}):
+            while self._min_other_clock() < need:
+                self._check_alive()
+                if time.time() - t0 > timeout_s:
+                    raise TimeoutError(
+                        f"worker {self.worker} stuck at gate: need clock "
+                        f"{need}, have {self.clocks} (a peer died and "
+                        f"eviction is disabled?)")
+                resp = self._pull_rpc({"kind": "clocks"})
+                self._absorb_view(resp)
+                time.sleep(poll_s)
         waited = time.time() - t0
         self.blocked_s += waited
         return waited
@@ -920,9 +927,10 @@ class AsyncSSPClient:
         pending rebuild scales raw gradients by -init_step (the client-lr
         preview), never adds them raw."""
         self._check_alive()
-        if self.server_logic == "adarevision":
-            self._drain()
-        snap = self._pull_rpc({"kind": "pull"})
+        with _spans.span("async_pull", "async", {"worker": self.worker}):
+            if self.server_logic == "adarevision":
+                self._drain()
+            snap = self._pull_rpc({"kind": "pull"})
         self._absorb_view(snap)
         applied = self.clocks.get(self.worker, -1)
         cache = snap["anchor"]
@@ -970,7 +978,8 @@ class AsyncSSPClient:
         clock), so the engine tier calls ONE method for fresh workers,
         restarts, and true mid-run admissions alike. Returns
         (cache, clock_vector)."""
-        snap = self._pull_rpc({"kind": "admit", "worker": self.worker})
+        with _spans.span("async_admit", "async", {"worker": self.worker}):
+            snap = self._pull_rpc({"kind": "admit", "worker": self.worker})
         self._absorb_view(snap)
         join = int(snap.get("join_clock",
                             self.clocks.get(self.worker, -1)))

@@ -427,13 +427,16 @@ def chained_bucket_psums(bufs, axes: tuple, reduce: str,
     bit-identical to independent per-bucket (and per-leaf) psums."""
     out = []
     tok = None
-    for g in bufs:
-        if tok is not None:
-            g = jnp.where(tok < jnp.inf, g, jnp.full_like(g, jnp.nan))
-        s = wire_psum(g, axes, reduce, wire)
-        t = s[0].astype(jnp.float32)
-        tok = t if tok is None else jnp.minimum(tok, t)
-        out.append(s)
+    # one named scope per bucket: a profiled step attributes each bucket's
+    # collective (and its overlap window) individually in the xplane
+    for i, g in enumerate(bufs):
+        with jax.named_scope(f"grad_sync_bucket{i}"):
+            if tok is not None:
+                g = jnp.where(tok < jnp.inf, g, jnp.full_like(g, jnp.nan))
+            s = wire_psum(g, axes, reduce, wire)
+            t = s[0].astype(jnp.float32)
+            tok = t if tok is None else jnp.minimum(tok, t)
+            out.append(s)
     return tuple(out)
 
 

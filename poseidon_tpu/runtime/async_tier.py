@@ -30,6 +30,7 @@ from ..parallel.async_ssp import AsyncSSPClient, ParamService
 # because the engine and the existing tests import it from this module
 from .cluster import env_world, is_elastic_joiner  # noqa: F401
 from .metrics import log
+from .spans import recorder as _spans
 
 
 def _to_host(tree: Dict) -> Dict:
@@ -167,6 +168,10 @@ class AsyncSSPTier:
         self._iters_since += n_iters
         if self._iters_since < self.sync_every:
             return
+        with _spans.span("async_flush", "async", {"rank": self.rank}):
+            self._flush(engine)
+
+    def _flush(self, engine) -> None:
         cur = _to_host(engine.params)
         delta = {l: {p: cur[l][p] - self._prev[l][p] for p in ps}
                  for l, ps in cur.items()}

@@ -90,6 +90,7 @@ def _engine_from_args(args, phase_nets=True):
             if v is not None and v >= 0:
                 async_cfg[key] = v
         staleness = 0
+    metrics_port = getattr(args, "metrics_port", -1)
     return Engine(sp, comm=comm, mesh=mesh, output_dir=args.output_dir,
                   staleness=staleness, sfb_auto=args.sfb_auto,
                   steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
@@ -97,7 +98,9 @@ def _engine_from_args(args, phase_nets=True):
                   async_ssp=async_cfg,
                   device_prefetch=getattr(args, "device_prefetch", None),
                   max_in_flight=getattr(args, "max_in_flight", None),
-                  async_snapshot=getattr(args, "async_snapshot", None))
+                  async_snapshot=getattr(args, "async_snapshot", None),
+                  trace_out=getattr(args, "trace_out", "") or None,
+                  metrics_port=metrics_port if metrics_port >= 0 else None)
 
 
 def _enable_compile_cache_from_args(args) -> None:
@@ -778,6 +781,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(best-effort; false keeps only the XLA cache)")
     t.add_argument("--profile", type=int, default=0,
                    help="capture an xplane trace over N steps (from step 10)")
+    t.add_argument("--trace_out", default="",
+                   help="host-side span timeline: record dispatch/hard-"
+                        "sync/snapshot/prefetch-stall and async-tier "
+                        "push/pull/gate/admit spans and write Chrome "
+                        "trace-event JSON here (relative to --output_dir), "
+                        "refreshed atomically at every display boundary; "
+                        "load in chrome://tracing or Perfetto")
+    t.add_argument("--metrics_port", type=int, default=-1,
+                   help="serve live training counters over HTTP on this "
+                        "loopback port (0 = ephemeral, logged at startup): "
+                        "curl it mid-run for text key=value — iteration, "
+                        "loss, input_stall, membership churn; negative = "
+                        "off")
     t.add_argument("--device_transform", action="store_true",
                    help="ship uint8 crops and apply (x - mean_value) * "
                         "scale on device (4x fewer host->device bytes; "

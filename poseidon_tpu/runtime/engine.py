@@ -40,7 +40,9 @@ from ..proto.messages import NetParameter, SolverParameter, load_net
 from ..solvers.updates import learning_rate
 from .checkpoint import (AsyncSnapshotWriter, latest_snapshot,
                          load_caffemodel, restore, snapshot, sweep_stale_tmp)
-from .metrics import AsyncScalarFetcher, MetricsTable, StatsRegistry, log
+from .metrics import (AsyncScalarFetcher, MetricsServer, MetricsTable,
+                      StatsRegistry, log)
+from .spans import recorder as span_recorder
 
 
 class TrainingDivergedError(RuntimeError):
@@ -101,6 +103,8 @@ class Engine:
         device_prefetch: Optional[int] = None,
         max_in_flight: Optional[int] = None,
         async_snapshot: Optional[bool] = None,
+        trace_out: Optional[str] = None,
+        metrics_port: Optional[int] = None,
     ):
         self.sp = sp
         # step-pipeline knobs: explicit args win, else the global policy
@@ -124,6 +128,27 @@ class Engine:
         self.stats = StatsRegistry()
         self.rank = jax.process_index()
         self.world = jax.process_count()
+        # --- telemetry spine ------------------------------------------- #
+        # --trace_out enables the process-wide span recorder (dispatch /
+        # hard-sync / snapshot / prefetch-stall spans, plus whatever the
+        # async tier records) and dumps a Chrome trace-event JSON at every
+        # display boundary and at exit. (--metrics_port is wired up BELOW,
+        # after the async tier resolves this process's real rank.)
+        self._trace_out: Optional[str] = None
+        self._owns_span_recorder = False
+        if trace_out:
+            self._trace_out = (trace_out if os.path.isabs(trace_out)
+                               else os.path.join(output_dir, trace_out))
+            self._owns_span_recorder = not span_recorder.enabled
+            # fresh ownership = fresh timeline: a previous engine's spans
+            # (the recorder is process-global) must not ghost-prefix this
+            # run's dump
+            if self._owns_span_recorder:
+                span_recorder.clear()
+            span_recorder.enable()
+        self._metrics_server: Optional[MetricsServer] = None
+        self.metrics_port: Optional[int] = None
+        self._metrics_port_arg = metrics_port
         # wait-free async-SSP process tier (runtime/async_tier.py): the
         # processes are INDEPENDENT jax runtimes (no jax.distributed world),
         # so rank/world come from the launcher env, the local mesh is this
@@ -134,6 +159,34 @@ class Engine:
         if async_ssp is not None:
             from .async_tier import env_world
             self.rank, self.world, _ = env_world()
+        # --metrics_port: read-only HTTP endpoint for the stats registry
+        # (text key=value, curl-able mid-run). Created only now that the
+        # async tier has resolved the REAL rank: a fixed port is bound by
+        # rank 0 alone — every worker of a multi-process job gets the same
+        # CLI args, and N processes racing one port is EADDRINUSE, not
+        # telemetry. Port 0 (ephemeral) binds on every rank.
+        if self._metrics_port_arg is not None and \
+                self._metrics_port_arg >= 0:
+            if self._metrics_port_arg == 0 or self.rank == 0:
+                try:
+                    self._metrics_server = MetricsServer(
+                        self.stats, port=self._metrics_port_arg)
+                except OSError as e:
+                    # an optional read-only endpoint must never abort a
+                    # training run (a stale daemon holding the port is
+                    # the operator's most likely EADDRINUSE)
+                    log(f"WARNING: --metrics_port "
+                        f"{self._metrics_port_arg} unavailable ({e}); "
+                        f"training continues without the endpoint",
+                        rank=self.rank)
+                else:
+                    self.metrics_port = self._metrics_server.port
+                    # printed from EVERY rank that bound a server (the
+                    # ADMITTED-line idiom): an ephemeral port nobody
+                    # logged is an endpoint nobody can curl
+                    log(f"metrics endpoint (rank {self.rank}): "
+                        f"http://127.0.0.1:{self.metrics_port}/ "
+                        f"(text key=value)")
         self.memory_data = memory_data
         # data assignment: launch-time (rank, world) for the fixed-world
         # tiers; the async tier re-keys it by the CURRENT member list via
@@ -714,9 +767,13 @@ class Engine:
                     # step's metrics must be seen BEFORE persisting params,
                     # so a NaN that the drainer has not surfaced yet can
                     # never be snapshotted and then silently auto-resumed
-                    last = self._absorb(fetcher.sync(), last)
+                    with span_recorder.span("hard_sync", "sync",
+                                            {"boundary": "snapshot"}):
+                        last = self._absorb(fetcher.sync(), last)
                     self._check_divergence(fetcher)
-                    self.snapshot_now()
+                    with span_recorder.span("snapshot", "ckpt",
+                                            {"iter": it}):
+                        self.snapshot_now()
                 if self.profile_steps and it == profile_start:
                     jax.profiler.start_trace(
                         os.path.join(self.output_dir, "profile"))
@@ -748,10 +805,12 @@ class Engine:
 
                 if chunk > 1:
                     t_in = time.perf_counter()
-                    batch = self._next_batch_stack(
-                        self.train_pipelines, chunk * self.iter_size,
-                        lead_shape=((chunk, self.iter_size)
-                                    if self.iter_size > 1 else None))
+                    with span_recorder.span("prefetch_wait", "input",
+                                            {"iter": it, "chunk": chunk}):
+                        batch = self._next_batch_stack(
+                            self.train_pipelines, chunk * self.iter_size,
+                            lead_shape=((chunk, self.iter_size)
+                                        if self.iter_size > 1 else None))
                     self.stats.add_time("input_stall",
                                         time.perf_counter() - t_in)
                     t0 = time.time()
@@ -759,25 +818,30 @@ class Engine:
                     # (solver.it + offset): pass the session rng unfolded so
                     # a chunked run's per-step streams match single-step
                     # dispatch
-                    self.params, self.state, m = self._scan_step.step(
-                        self.params, self.state, batch, self.rng)
+                    with span_recorder.span("dispatch", "step",
+                                            {"iter": it, "chunk": chunk}):
+                        self.params, self.state, m = self._scan_step.step(
+                            self.params, self.state, batch, self.rng)
                     it += chunk
                     at_display = bool(sp.display) and it % sp.display == 0
                 else:
                     t_in = time.perf_counter()
-                    if self.iter_size > 1:
-                        # one optimizer step = iter_size stacked micro-batches
-                        batch = self._next_batch_stack(
-                            self.train_pipelines, self.iter_size,
-                            sharding=self.train_step.batch_sharding)
-                    elif self._device_feed is not None:
-                        # the prefetch stage already placed this batch on
-                        # device with the step's sharding; steady state this
-                        # dequeue is instant and input_stall measures any
-                        # residual starvation
-                        batch = next(self._device_feed)
-                    else:
-                        batch = self._next_batch(self.train_pipelines)
+                    with span_recorder.span("prefetch_wait", "input",
+                                            {"iter": it}):
+                        if self.iter_size > 1:
+                            # one optimizer step = iter_size stacked
+                            # micro-batches
+                            batch = self._next_batch_stack(
+                                self.train_pipelines, self.iter_size,
+                                sharding=self.train_step.batch_sharding)
+                        elif self._device_feed is not None:
+                            # the prefetch stage already placed this batch
+                            # on device with the step's sharding; steady
+                            # state this dequeue is instant and input_stall
+                            # measures any residual starvation
+                            batch = next(self._device_feed)
+                        else:
+                            batch = self._next_batch(self.train_pipelines)
                     self.stats.add_time("input_stall",
                                         time.perf_counter() - t_in)
                     at_display = bool(sp.display) and \
@@ -799,8 +863,10 @@ class Engine:
                             log(f"    [debug] {kind:<5} {name}: "
                                 f"{float(stats[key]):.6g}", rank=self.rank)
                     t0 = time.time()
-                    result = self._dispatch_train_step(
-                        batch, jax.random.fold_in(self.rng, it))
+                    with span_recorder.span("dispatch", "step",
+                                            {"iter": it}):
+                        result = self._dispatch_train_step(
+                            batch, jax.random.fold_in(self.rng, it))
                     if self._h5_train:
                         self.params, self.state, m, dumps = result
                         self._write_train_h5(dumps)
@@ -818,7 +884,10 @@ class Engine:
                 # drainer materializes them to host floats off-thread, and
                 # put() blocks only when max_in_flight dispatches are still
                 # un-materialized — the bounded in-flight dispatch window
-                fetcher.put(it - chunk, m)
+                # (the span measures exactly the window backpressure wait)
+                with span_recorder.span("dispatch_window", "step",
+                                        {"iter": it}):
+                    fetcher.put(it - chunk, m)
                 self._check_divergence(fetcher)
                 self.stats.add("train_iters", chunk)
                 self.stats.add_time("train_step", time.time() - t0)
@@ -832,7 +901,9 @@ class Engine:
                     # hard sync: the displayed window must cover every step
                     # through `it` (the drainer may lag by the in-flight
                     # window otherwise)
-                    last = self._absorb(fetcher.sync(), last)
+                    with span_recorder.span("hard_sync", "sync",
+                                            {"boundary": "display"}):
+                        last = self._absorb(fetcher.sync(), last)
                     self._check_divergence(fetcher)
                     row = self.metrics.flush_row(it)
                     lr = float(learning_rate(sp, jnp.asarray(it - 1)))
@@ -841,6 +912,15 @@ class Engine:
                         if k not in ("iter", "time"))
                     log(f"Iteration {it}, lr = {lr:.6g}, {extras}",
                         rank=self.rank)
+                    # live telemetry rides the display cadence: gauges for
+                    # the metrics endpoint, plus the atomic stats.yaml /
+                    # span-timeline dump (a preempted run keeps both)
+                    self.stats.set_gauge("iteration", it)
+                    self.stats.set_gauge("lr", lr)
+                    for k, v in row.items():
+                        if k not in ("iter", "time"):
+                            self.stats.set_gauge(f"train_{k}", round(v, 6))
+                    self._dump_live_telemetry()
                     if self._async_tier is not None:
                         # membership churn rides the display cadence, so
                         # admissions/evictions are visible without
@@ -854,14 +934,18 @@ class Engine:
                     # test boundary = hard sync point too: never spend a
                     # full eval sweep on a model a still-draining NaN has
                     # already poisoned
-                    last = self._absorb(fetcher.sync(), last)
+                    with span_recorder.span("hard_sync", "sync",
+                                            {"boundary": "test"}):
+                        last = self._absorb(fetcher.sync(), last)
                     self._check_divergence(fetcher)
                     for i in range(len(self.test_nets)):
                         self.test(i)
                         self.test_metrics[i].flush_row(it)
 
             # tail iterations past the last display boundary
-            last = self._absorb(fetcher.sync(), last)
+            with span_recorder.span("hard_sync", "sync",
+                                    {"boundary": "final"}):
+                last = self._absorb(fetcher.sync(), last)
             self._check_divergence(fetcher)
         finally:
             self.stats.counters["steps_in_flight"] = round(
@@ -881,7 +965,9 @@ class Engine:
                 self.stats.add(k, v)
             self._async_tier = None
         if sp.snapshot_after_train:
-            self.snapshot_now()
+            with span_recorder.span("snapshot", "ckpt",
+                                    {"boundary": "after_train"}):
+                self.snapshot_now()
         if self._snap_writer is not None:
             # train() returning means the artifacts exist: join the last
             # background write (and surface its failure loudly)
@@ -926,6 +1012,39 @@ class Engine:
             log(f"HDF5 output -> {path}", rank=self.rank)
 
     # ---------------------------------------------------------------- #
+    def _trace_out_path(self) -> Optional[str]:
+        """This rank's span-timeline path: rank 0 writes the requested
+        file, workers write a ``.rank<k>`` sibling (every process records
+        its own timeline — async push/gate spans live on the workers, and
+        an output_dir may be shared)."""
+        if self._trace_out is None:
+            return None
+        if self.rank == 0:
+            return self._trace_out
+        base, ext = os.path.splitext(self._trace_out)
+        return f"{base}.rank{self.rank}{ext or '.json'}"
+
+    def _dump_live_telemetry(self):
+        """Display-boundary telemetry flush: stats.yaml (atomic tmp +
+        rename — a crashed/preempted run keeps everything through its
+        last boundary, rank 0 only) and, under --trace_out, this rank's
+        span timeline. Best-effort: a full disk or NFS blip at a display
+        boundary must never abort a training run that could keep going
+        (the exit-time writers retry the same paths anyway)."""
+        try:
+            if self.rank == 0:
+                self.stats.dump_yaml(os.path.join(self.output_dir,
+                                                  "stats.yaml"))
+            path = self._trace_out_path()
+            if path is not None:
+                span_recorder.dump(path)
+        except OSError as e:
+            if not getattr(self, "_telemetry_write_warned", False):
+                self._telemetry_write_warned = True
+                log(f"WARNING: telemetry write failed ({e}); training "
+                    f"continues, will retry at the next boundary",
+                    rank=self.rank)
+
     def _write_artifacts(self):
         if self.rank != 0:
             return
@@ -948,6 +1067,14 @@ class Engine:
                 tm.to_csv(os.path.join(self.output_dir,
                                        f"{name}_test{i}_outputs.csv"))
         self.stats.dump_yaml(os.path.join(self.output_dir, "stats.yaml"))
+        if self._trace_out is not None:
+            try:
+                log(f"Wrote span timeline to "
+                    f"{span_recorder.dump(self._trace_out)}",
+                    rank=self.rank)
+            except OSError as e:
+                log(f"WARNING: span timeline write failed: {e}",
+                    rank=self.rank)
 
     def close(self):
         # close EVERYTHING before surfacing any failure: a snapshot-write
@@ -955,6 +1082,25 @@ class Engine:
         # and an aborted (diverged/interrupted) run must not leak the
         # async tier's sockets behind the skipped finish() protocol
         err: Optional[BaseException] = None
+        if self._owns_span_recorder:
+            # final timeline flush (every rank writes its own file), then
+            # stand the recorder down (it is process-global; a later
+            # engine without --trace_out must not keep paying for spans
+            # nobody will dump)
+            path = self._trace_out_path()
+            if path is not None:
+                try:
+                    span_recorder.dump(path)
+                except OSError:
+                    pass
+            span_recorder.disable()
+            self._owns_span_recorder = False
+        if self._metrics_server is not None:
+            try:
+                self._metrics_server.close()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+            self._metrics_server = None
         if self._snap_writer is not None:
             try:
                 self._snap_writer.close()

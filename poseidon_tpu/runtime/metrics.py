@@ -56,21 +56,69 @@ class StatsRegistry:
 
     ``set_section`` attaches a nested dict (e.g. the static per-layer comm
     accounting from comm_stats.py — the analog of the reference's bg oplog
-    bytes / server push bytes stats)."""
+    bytes / server push bytes stats). Thread-safe: the engine loop, span
+    instrumentation, serving handler threads and the live metrics endpoint
+    (:class:`MetricsServer`) all touch one registry concurrently.
+
+    The YAML dump is atomic (tmp + rename) and the engine calls it at
+    every display boundary — a crashed or preempted run keeps its
+    telemetry up to the last boundary, with only sweepable tmp litter."""
 
     def __init__(self):
         self.counters: Dict[str, float] = defaultdict(float)
         self.timers: Dict[str, float] = defaultdict(float)
+        self.gauges: Dict[str, float] = {}
         self.sections: Dict[str, dict] = {}
+        self._lock = threading.Lock()
 
     def add(self, name: str, value: float = 1.0) -> None:
-        self.counters[name] += value
+        with self._lock:
+            self.counters[name] += value
 
     def add_time(self, name: str, seconds: float) -> None:
-        self.timers[name] += seconds
+        with self._lock:
+            self.timers[name] += seconds
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Last-value-wins instantaneous reading (iteration, loss, queue
+        depth) — the live-endpoint counterpart of a monotonic counter."""
+        with self._lock:
+            self.gauges[name] = value
 
     def set_section(self, name: str, data: dict) -> None:
-        self.sections[name] = data
+        with self._lock:
+            self.sections[name] = data
+
+    def snapshot(self) -> Dict[str, dict]:
+        """A consistent copy of everything (one lock hold)."""
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "timers_sec": {k: round(v, 6)
+                                   for k, v in self.timers.items()},
+                    "gauges": dict(self.gauges),
+                    "sections": {k: dict(v)
+                                 for k, v in self.sections.items()}}
+
+    def render_text(self) -> str:
+        """Flat ``key=value`` lines — what ``--metrics_port`` serves (one
+        curl mid-run answers "where is this job"). Sections flatten with
+        dotted keys; non-scalar leaves are skipped (the YAML has them)."""
+        snap = self.snapshot()
+        lines = []
+
+        def emit(prefix: str, tree: dict) -> None:
+            for k in sorted(tree):
+                v = tree[k]
+                if isinstance(v, dict):
+                    emit(f"{prefix}{k}.", v)
+                elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                    lines.append(f"{prefix}{k}={v}")
+
+        emit("", snap["counters"])
+        emit("", snap["gauges"])
+        emit("", {f"{k}_sec": v for k, v in snap["timers_sec"].items()})
+        emit("", snap["sections"])
+        return "\n".join(lines) + "\n"
 
     @staticmethod
     def _write_tree(f, tree: dict, indent: int) -> None:
@@ -82,18 +130,91 @@ class StatsRegistry:
             else:
                 f.write(f"{pad}{k}: {'null' if v is None else v}\n")
 
+    def render_yaml(self) -> str:
+        """The full stats.yaml document as a string — ONE renderer shared
+        by ``dump_yaml`` and the live ``/yaml`` endpoint, so the two can
+        never drift."""
+        import io
+        snap = self.snapshot()
+        f = io.StringIO()
+        f.write("counters:\n")
+        for k in sorted(snap["counters"]):
+            f.write(f"  {k}: {snap['counters'][k]}\n")
+        f.write("timers_sec:\n")
+        for k in sorted(snap["timers_sec"]):
+            f.write(f"  {k}: {snap['timers_sec'][k]}\n")
+        if snap["gauges"]:
+            f.write("gauges:\n")
+            for k in sorted(snap["gauges"]):
+                f.write(f"  {k}: {snap['gauges'][k]}\n")
+        for name in sorted(snap["sections"]):
+            f.write(f"{name}:\n")
+            self._write_tree(f, snap["sections"][name], 1)
+        return f.getvalue()
+
     def dump_yaml(self, path: str) -> None:
+        """Atomic write (tmp + os.replace): a reader — or the next run's
+        auto-resume forensics — never sees a torn stats.yaml, and a
+        killed writer leaves only a sweepable ``.tmp.<pid>`` file."""
+        doc = self.render_yaml()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w") as f:
-            f.write("counters:\n")
-            for k in sorted(self.counters):
-                f.write(f"  {k}: {self.counters[k]}\n")
-            f.write("timers_sec:\n")
-            for k in sorted(self.timers):
-                f.write(f"  {k}: {round(self.timers[k], 6)}\n")
-            for name in sorted(self.sections):
-                f.write(f"{name}:\n")
-                self._write_tree(f, self.sections[name], 1)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(doc)
+        os.replace(tmp, path)
+
+
+class MetricsServer:
+    """The ``--metrics_port`` one-liner: a read-only HTTP endpoint serving
+    a StatsRegistry as ``text/plain`` key=value lines, curl-able mid-run.
+
+    GET /        -> flat key=value (render_text)
+    GET /yaml    -> the stats.yaml document, rendered live
+
+    Runs a daemon-threaded stdlib HTTP server; ``port=0`` binds an
+    ephemeral port (read it back from ``.port`` — the tests do). Strictly
+    read-only: no mutation op exists, so exposing it on loopback during a
+    long run costs nothing but a socket."""
+
+    def __init__(self, registry: "StatsRegistry", port: int = 0,
+                 host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 — stdlib contract
+                if self.path.rstrip("/") == "/yaml":
+                    body = reg.render_yaml().encode()
+                else:
+                    body = reg.render_text().encode()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    # client went away / endpoint closing mid-reply: a
+                    # read-only metrics poll is never worth a stack trace
+                    pass
+
+            def log_message(self, *args):  # quiet: not request-log noise
+                pass
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._srv.daemon_threads = True
+        self.host = host
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5.0)
 
 
 def scalar_rows(metrics: Dict) -> List[Dict[str, float]]:

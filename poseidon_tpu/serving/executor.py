@@ -108,6 +108,11 @@ class BucketedExecutor:
         self.calls: Dict[int, int] = {b: 0 for b in self.buckets}
         self.rows_served = 0
         self.rows_padded = 0
+        # per-bucket fill accounting: which rungs of the ladder run full
+        # and which mostly dispatch padding (the capacity-planning signal
+        # the `stats` op exports as executor_bucket_fill)
+        self.rows_by_bucket: Dict[int, int] = {b: 0 for b in self.buckets}
+        self.padded_by_bucket: Dict[int, int] = {b: 0 for b in self.buckets}
 
         def fwd(p, inputs):
             return net.apply(p, inputs, train=False).outputs
@@ -150,6 +155,18 @@ class BucketedExecutor:
     @property
     def max_batch(self) -> int:
         return self.buckets[-1]
+
+    def bucket_fill(self) -> Dict[int, Optional[float]]:
+        """{bucket: real-rows / dispatched-rows} per ladder rung (None
+        until a rung has served). 1.0 = every dispatched row was a real
+        request row; low fill on a big rung means its compile slot mostly
+        pads — a ladder worth re-cutting."""
+        out: Dict[int, Optional[float]] = {}
+        for b in self.buckets:
+            total = self.rows_by_bucket[b] + self.padded_by_bucket[b]
+            out[b] = round(self.rows_by_bucket[b] / total, 4) if total \
+                else None
+        return out
 
     # ---- serving -------------------------------------------------------- #
     def validate_request(self, inputs: Dict[str, np.ndarray]) -> int:
@@ -198,6 +215,8 @@ class BucketedExecutor:
         self.calls[bucket] += 1
         self.rows_served += rows
         self.rows_padded += bucket - rows
+        self.rows_by_bucket[bucket] += rows
+        self.padded_by_bucket[bucket] += bucket - rows
         return {k: (np.asarray(v)[:rows]
                     if np.ndim(v) >= 1 and np.shape(v)[0] == bucket
                     else np.asarray(v))

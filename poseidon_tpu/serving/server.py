@@ -203,9 +203,22 @@ class InferenceServer:
             "rows_served": self.executor.rows_served,
             "rows_padded": self.executor.rows_padded,
             "bucket_calls": dict(self.executor.calls),
+            # per-rung fill: which compile slots dispatch real rows vs
+            # padding (capacity signal for re-cutting the bucket ladder);
+            # getattr: duck-typed test executors need not implement it
+            "executor_bucket_fill": getattr(self.executor, "bucket_fill",
+                                            lambda: None)(),
             "params_version": self.executor.params_version,
             "reloads": (0 if self.reloader is None
                         else self.reloader.reloads),
+            # the reloader's full swap telemetry (hot-reload health must
+            # be visible from the stats op, not only the server log)
+            "reloader": (None if self.reloader is None else {
+                "reloads": self.reloader.reloads,
+                "failed_reloads": self.reloader.failed_reloads,
+                "last_error": self.reloader.last_error,
+                "current_path": self.reloader.current_path,
+            }),
             "uptime_s": round(time.time() - self._started, 3),
             "draining": self.draining,
         }

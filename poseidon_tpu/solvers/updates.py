@@ -107,10 +107,14 @@ def make_update_fn(sp: SolverParameter, mults: Dict[str, Dict[str, tuple]]):
     net's ParamDefs (the reference's blobs_lr / weight_decay lists).
     """
     def update(params, grads, state: SolverState):
-        rate = learning_rate(sp, state.it)
-        new_params, new_hist = _leafwise_update(sp, mults, rate, params,
-                                                grads, state.history)
-        return new_params, SolverState(it=state.it + 1, history=new_hist)
+        # scoped so one profiled step attributes the whole optimizer pass
+        # as "optimizer_update" instead of leaking per-leaf fusions into
+        # the attribution residual (runtime/attribution.py)
+        with jax.named_scope("optimizer_update"):
+            rate = learning_rate(sp, state.it)
+            new_params, new_hist = _leafwise_update(sp, mults, rate, params,
+                                                    grads, state.history)
+            return new_params, SolverState(it=state.it + 1, history=new_hist)
 
     return update
 
@@ -181,15 +185,16 @@ def make_arena_update_fn(sp: SolverParameter, mults, layout):
     fused = make_fused_update_fn(sp, layout)
 
     def update(flat_w, flat_g, excl_params, excl_grads, state: SolverState):
-        rate = learning_rate(sp, state.it)
-        flat_h = layout.pack(state.history)
-        new_flat_w, new_flat_h = fused(flat_w, flat_g, flat_h, rate)
-        excl_hist = layout.residual(state.history)
-        new_excl, new_excl_hist = _leafwise_update(
-            sp, mults, rate, excl_params, excl_grads, excl_hist)
-        new_params = layout.merge(layout.unpack(new_flat_w), new_excl)
-        new_hist = layout.merge(layout.unpack(new_flat_h), new_excl_hist)
-        return new_params, SolverState(it=state.it + 1, history=new_hist)
+        with jax.named_scope("optimizer_update"):
+            rate = learning_rate(sp, state.it)
+            flat_h = layout.pack(state.history)
+            new_flat_w, new_flat_h = fused(flat_w, flat_g, flat_h, rate)
+            excl_hist = layout.residual(state.history)
+            new_excl, new_excl_hist = _leafwise_update(
+                sp, mults, rate, excl_params, excl_grads, excl_hist)
+            new_params = layout.merge(layout.unpack(new_flat_w), new_excl)
+            new_hist = layout.merge(layout.unpack(new_flat_h), new_excl_hist)
+            return new_params, SolverState(it=state.it + 1, history=new_hist)
 
     return update
 

@@ -4,14 +4,15 @@ compile-expensive runtimes).
 `time --per_layer` jits every layer's forward and backward separately —
 ~42 compiles for AlexNet, which times out over the tunneled backend where
 each remote compile is tens of seconds. This tool gets the same table from
-a single compile: Net.apply wraps each layer in ``jax.named_scope``, so
-every HLO instruction's metadata op_name carries its layer; we compile the
-bench train step, map instruction -> layer from the compiled module text,
-profile ONE step, and join the device-trace events against that map.
+a single compile and one traced step.
 
-Fusions spanning layers are attributed to the fusion root's layer (XLA's
-own convention for metadata); events whose instruction has no layer scope
-(optimizer update, collectives, infeed) land in "<unattributed>".
+Since round 7 the join itself lives in `poseidon_tpu/runtime/attribution.py`
+(the canonical implementation: call-graph scope resolution, flame-graph
+self time, tracer-overhead strip) and this script is a thin JSON front-end
+kept for `scripts/tpu_evidence.py` — `python bench.py attribution` is the
+full-featured mode (FLOPs/intensity/MFU columns, coverage gate, evidence
+artifact). The two can no longer disagree: same parser, same scope map,
+same accounting.
 
 Prints ONE JSON line:
   {"metric": "layer_time_from_trace", "total_ms": N,
@@ -26,34 +27,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
 import tempfile
-from collections import defaultdict
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-
-INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=.*metadata=\{[^}]*"
-                      r"op_name=\"([^\"]*)\"")
-
-
-def instr_layer_map(hlo_text: str, layer_names) -> dict:
-    """instruction name -> (layer, is_backward) from compiled-module text."""
-    names = set(layer_names)
-    out = {}
-    for line in hlo_text.splitlines():
-        m = INSTR_RE.match(line)
-        if not m:
-            continue
-        instr, op_name = m.groups()
-        # layer names arrive wrapped by autodiff scopes — jvp(conv1),
-        # transpose(jvp(conv1)) — so match word tokens, not path segments
-        tokens = re.findall(r"[\w.\-]+", op_name)
-        layer = next((t for t in tokens if t in names), None)
-        if layer is not None:
-            out[instr] = (layer, "transpose(" in op_name)
-    return out
 
 
 def main() -> int:
@@ -70,73 +48,52 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
     import jax
 
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    from analyze_overlap import load_device_events, find_xplane
-    from bench import _build
+    from bench import ATTR_EXTRA_SCOPES, _build
+    from poseidon_tpu.runtime import attribution as A
 
     payload: dict = {"metric": "layer_time_from_trace",
                      "backend": jax.default_backend(), "model": args.model}
     try:
-        ts, params, state, batch = _build(
-            args.model, args.batch, args.image, args.classes)
+        ts, params, state, batch, net = _build(
+            args.model, args.batch, args.image, args.classes,
+            return_net=True)
         rng = jax.random.PRNGKey(1)
         lowerable = ts.lowerable or ts.step
         compiled = lowerable.lower(params, state, batch, rng).compile()
-        hlo = compiled.as_text()
-        # layer names = the net's layers; rebuild cheaply for the name list
-        from poseidon_tpu.models import zoo
-        net_param = (zoo.alexnet(num_classes=args.classes,
-                                 with_accuracy=False)
-                     if args.model == "alexnet"
-                     else zoo.googlenet(num_classes=args.classes,
-                                        with_accuracy=False))
-        layer_names = [lp.name for lp in net_param.layers]
-        imap = instr_layer_map(hlo, layer_names)
-        payload["n_attributed_instructions"] = len(imap)
+        scope_map = A.hlo_scope_map(compiled.as_text(),
+                                    {layer.name for layer in net.layers},
+                                    ATTR_EXTRA_SCOPES)
+        payload["n_attributed_instructions"] = len(scope_map)
 
-        # warm, then profile exactly one step
-        params, state, m = ts.step(params, state, batch, rng)
-        jax.block_until_ready(m["loss"])
+        holder = {"params": params, "state": state}
+
+        def run_step():
+            out = compiled(holder["params"], holder["state"], batch, rng)
+            holder["params"], holder["state"], m = out[:3]
+            jax.block_until_ready(m["loss"])
+
         tmp = tempfile.mkdtemp(prefix="layer_trace_")
-        jax.profiler.start_trace(tmp)
-        params, state, m = ts.step(params, state, batch, rng)
-        jax.block_until_ready(m["loss"])
-        jax.profiler.stop_trace()
+        # iters >= 3: the first call pays one-time buffer setup, and the
+        # CPU tracer-overhead strip needs a clean min-wall baseline
+        timing = A.measure_then_trace(run_step, tmp, iters=3)
+        events = A.load_trace_events(tmp)
+        on_accel = jax.default_backend() not in ("cpu",)
+        result = A.attribute(
+            events, scope_map,
+            tracer_overhead_ms=None if on_accel else max(
+                timing["traced_step_ms"] - timing["step_ms"], 0.0))
 
-        planes = load_device_events(find_xplane(tmp))
-        per = defaultdict(lambda: [0.0, 0.0])
-        unattr_by_name = defaultdict(float)
-        unattributed = 0.0
-        total = 0.0
-        for events in planes.values():
-            for name, _, dur in events:
-                base = re.sub(r"\.\d+$", "", name)
-                hit = imap.get(name) or imap.get(base)
-                # device event names sometimes carry %; strip and retry
-                if hit is None and name.startswith("%"):
-                    hit = imap.get(name[1:])
-                total += dur
-                if hit is None:
-                    unattributed += dur
-                    unattr_by_name[base] += dur
-                else:
-                    layer, bwd = hit
-                    per[layer][1 if bwd else 0] += dur
-        payload["total_ms"] = round(total / 1e9, 3)
-        payload["unattributed_ms"] = round(unattributed / 1e9, 3)
-        # top unattributed sinks by event base name: when attribution is
-        # poor, THIS is the diagnosis (fusions without layer scope,
-        # optimizer update, infeed, runtime rows) — kept in the artifact so
-        # a bad capture still names its own gap
+        payload["step_ms_timed"] = timing["step_ms"]
+        payload["total_ms"] = result["total_ms"]
+        payload["unattributed_ms"] = result["residual"]["total_ms"]
+        payload["coverage"] = result["coverage"]
+        # top unattributed sinks: when attribution is poor, THIS is the
+        # diagnosis — kept so a bad capture still names its own gap
         payload["top_unattributed"] = {
-            k: round(v / 1e9, 3)
-            for k, v in sorted(unattr_by_name.items(),
-                               key=lambda kv: -kv[1])[:12]}
+            r["op"]: r["ms"] for r in result["residual"]["top_ops"]}
         payload["layers"] = {
-            k: {"fwd_ms": round(v[0] / 1e9, 3),
-                "bwd_ms": round(v[1] / 1e9, 3)}
-            for k, v in sorted(per.items(),
-                               key=lambda kv: -(kv[1][0] + kv[1][1]))}
+            r["layer"]: {"fwd_ms": r["fwd_ms"], "bwd_ms": r["bwd_ms"]}
+            for r in result["rows"]}
     except Exception as e:  # noqa: BLE001
         import traceback
         payload["error"] = f"{type(e).__name__}: {e} | " + \
