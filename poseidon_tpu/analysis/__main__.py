@@ -1,0 +1,154 @@
+"""CLI for the static guardrails.
+
+    python -m poseidon_tpu.analysis                 # lints, baseline-aware
+    python -m poseidon_tpu.analysis path/to/file.py # lint specific targets
+    python -m poseidon_tpu.analysis --contracts all # HLO contract gates
+    python -m poseidon_tpu.analysis --refresh-contracts lenet,alexnet
+    python -m poseidon_tpu.analysis --write-baseline
+
+Exit codes: 0 clean; 1 NEW lint findings (not in baseline); 2 HLO
+contract violation; 3 usage error (e.g. an unknown model name); 4 the
+contract check itself failed to run (infra/compile error — the findings
+report is still written). The default invocation is jax-free and fast (pure
+AST), so it is safe as a pre-commit hook; ``--contracts`` traces and
+(for LeNet) compiles real models — seconds to a minute on CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (BASELINE_PATH, filter_new, load_baseline, run_lints,
+               save_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m poseidon_tpu.analysis",
+        description="concurrency + jit-hygiene lints and HLO contract "
+                    "gates")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package + the "
+                         "instrumented scripts)")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="grandfather list (default: the checked-in one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--fail-on-new", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="exit 1 on findings not in the baseline (default; "
+                         "kept explicit for CI readability — "
+                         "--no-fail-on-new for a report-only survey)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline to grandfather the current "
+                         "findings (carries over existing reasons)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to restrict to")
+    ap.add_argument("--report", default=None,
+                    help="write a JSON findings report here (CI artifact)")
+    ap.add_argument("--contracts", default=None, metavar="MODELS",
+                    help="verify HLO contracts: 'all' or a comma list of "
+                         "lenet,alexnet,googlenet (imports jax)")
+    ap.add_argument("--refresh-contracts", default=None, metavar="MODELS",
+                    help="recompute + rewrite contract goldens, printing "
+                         "the diff for review")
+    # ALL usage errors exit 3 — argparse's default of 2 collides with
+    # the documented contract-violation code
+    ap.error = lambda msg: ap.exit(3, f"{ap.prog}: error: {msg}\n")
+    args = ap.parse_args(argv)
+
+    # a typo'd target must not pass as "0 findings": a guardrail that
+    # silently lints nothing is worse than none
+    for p in args.paths:
+        if not os.path.exists(p):
+            ap.error(f"lint target does not exist: {p!r}")
+
+    rules = args.rules.split(",") if args.rules else None
+    findings = run_lints(args.paths or None, rules=rules)
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new = filter_new(findings, baseline)
+
+    if args.write_baseline:
+        # a restricted run sees only a subset of findings; rewriting the
+        # whole grandfather list from it would delete every other curated
+        # entry (and its written reason)
+        if args.paths or args.rules:
+            ap.exit(3, f"{ap.prog}: error: --write-baseline rewrites the "
+                       f"WHOLE grandfather list; run it without path "
+                       f"arguments or --rules\n")
+        # carry reasons from the on-disk baseline even under
+        # --no-baseline (that flag only widens REPORTING; rewriting the
+        # grandfather list must never drop the curated justifications)
+        path = save_baseline(findings, reasons=load_baseline(args.baseline),
+                             path=args.baseline)
+        print(f"baseline rewritten: {path} ({len(findings)} findings "
+              f"grandfathered)")
+        return 0
+
+    for f in new:
+        print(f.render())
+    n_base = len(findings) - len(new)
+    print(f"{len(new)} new finding(s), {n_base} baselined "
+          f"({len(findings)} total)")
+
+    report = {"findings": [vars(f) | {"fingerprint": f.fingerprint,
+                                      "baselined": f.fingerprint in baseline}
+                           for f in findings],
+              "new": len(new), "baselined": n_base}
+    rc = 1 if (new and args.fail_on_new) else 0
+
+    from . import contracts as C
+
+    def parse_models(spec: str):
+        # validate BEFORE any golden is touched: a typo'd model in a
+        # --refresh-contracts list must not leave the contract dir
+        # half-rewritten
+        models = (C.MODELS if spec == "all"
+                  else tuple(m.strip() for m in spec.split(",") if m.strip()))
+        bad = [m for m in models if m not in C.MODELS]
+        if bad:
+            # NOT ap.error: argparse exits 2, which the CLI contract
+            # reserves for a real contract violation
+            ap.exit(3, f"{ap.prog}: error: unknown model(s) {bad}; "
+                       f"choose from {list(C.MODELS)} or 'all'\n")
+        if not models:
+            # a gate over zero models is vacuously "ok" — an unset CI
+            # variable must not read as a passed contract check
+            ap.exit(3, f"{ap.prog}: error: empty model list; choose from "
+                       f"{list(C.MODELS)} or 'all'\n")
+        return models
+
+    try:
+        if args.refresh_contracts is not None:
+            C.refresh(parse_models(args.refresh_contracts))
+        elif args.contracts is not None:
+            ok, con_report = C.check_all(parse_models(args.contracts))
+            report["contracts"] = con_report
+            for m, r in con_report.items():
+                status = "ok" if r["ok"] else "VIOLATED"
+                print(f"contract {m}: {status}")
+                for d in r["diffs"]:
+                    print(f"  {d}")
+            if not ok:
+                rc = 2
+    except Exception as e:   # infra failure (OOM, jax init), NOT a lint
+        # regression (1) or a measured violation (2)
+        print(f"contract check failed to run: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        report["contracts_error"] = f"{type(e).__name__}: {e}"
+        rc = 4
+    finally:
+        # the lint half already completed — CI keeps its artifact even
+        # when the contract half dies (or a usage error exits early)
+        if args.report:
+            with open(args.report, "w") as f:
+                json.dump(report, f, indent=2)
+            print(f"report written: {args.report}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,310 @@
+"""HLO contract gates: checked-in per-model goldens for compiled invariants.
+
+The perf PRs bought specific, countable properties of the compiled train
+step — ~11 bucketed gradient psums on GoogLeNet instead of ~120 per-leaf
+all-reduces (PR 4), exactly 2 NHWC layout transposes on AlexNet (the fc6
+boundary pair, PR 3), donated param/state/batch buffers (PR 5), an
+f64-free program — and until now they lived as assertions scattered
+across tests that each compile their own subset. This module promotes
+them to *contracts*: one JSON per model under ``evidence/hlo_contracts/``
+recording the counters extracted from the lowered (StableHLO) and, where
+a CPU compile is affordable, optimized-HLO text of one full data-parallel
+optimizer step. The gate recomputes and diffs; ``refresh()`` rewrites the
+goldens and prints the diff for review.
+
+With the TPU tunnel down (ROADMAP item 2), these static gates are the
+only trustworthy proxy for the compiled program's shape — the
+Julia->TPU/XLA argument (arXiv:1810.09868) that whole-program
+ahead-of-time analysis is the natural fit for this regime.
+
+Compile-cost policy: tracing+lowering is seconds per model (the tier-1
+gate level); full XLA CPU compiles are minutes on GoogLeNet, so the
+``optimized`` section (fusion count) is recorded for LeNet only. The
+NHWC layout half re-traces a mesh-free step via
+``hlo_layout.net_transpose_report`` for AlexNet (the model the claim is
+about; LeNet is single-channel and GoogLeNet's NHWC plan is pinned by
+tests/test_layout_hlo.py). ROADMAP item 1's mesh work should EXTEND these
+contracts with its planned collective schedule per (mesh, model).
+
+Version drift: counters are exact goldens only under the jax version that
+generated them (recorded in ``generated_with``). Under a different jax,
+the gate falls back to the robust subset — gradient all-reduce count,
+layout transposes, f64-freedom, donation non-emptiness — and says so.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import REPO_ROOT
+
+CONTRACT_DIR = os.path.join(REPO_ROOT, "evidence", "hlo_contracts")
+MODELS = ("lenet", "alexnet", "googlenet")
+
+# per-model build recipe: image/channels follow the cheapest configuration
+# the existing suites already compile (tests/test_arena.py). The AlexNet
+# NHWC half runs at the real 227 px: at toy sizes pool5 degenerates to
+# 1x1 and the fc6 boundary pair it exists to pin folds away as bitcasts.
+_SPECS = {
+    "lenet": {"image": 28, "channels": 1, "classes": 10,
+              "optimized": True, "nhwc": False},
+    "alexnet": {"image": 67, "channels": 3, "classes": 10,
+                "optimized": False, "nhwc": True, "nhwc_image": 227},
+    "googlenet": {"image": 224, "channels": 3, "classes": 10,
+                  "optimized": False, "nhwc": False},
+}
+
+_BATCH = 8          # one row per device on the 8-device virtual mesh
+
+# exact-compare keys that survive jax upgrades (program-level, not
+# compiler-whim-level); everything else is exact only under the recorded
+# jax version
+ROBUST_KEYS = ("gradient_all_reduces", "layout_transposes", "f64_tensors")
+
+_TENSOR_DTYPE_RE = re.compile(r"tensor<[0-9x]*([a-z][a-z0-9]*)>")
+
+
+class ContractEnvironmentError(RuntimeError):
+    """The measurement substrate does not match the golden's (wrong device
+    count): the comparison is refused, not failed — CLI exit 4, never 2."""
+
+
+def contract_path(model: str) -> str:
+    return os.path.join(CONTRACT_DIR, f"{model}.json")
+
+
+def _dtype_census(stablehlo: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for m in _TENSOR_DTYPE_RE.finditer(stablehlo):
+        out[m.group(1)] = out.get(m.group(1), 0) + 1
+    return dict(sorted(out.items()))
+
+
+def _fusion_count(optimized_hlo: str) -> int:
+    return len(re.findall(r"\bfusion\(", optimized_hlo))
+
+
+def _build_net(model: str):
+    from ..core.net import Net
+    from ..models import zoo
+    spec = _SPECS[model]
+    if model == "lenet":
+        np_ = zoo.lenet(with_accuracy=False)
+        shapes = zoo.lenet_shapes(_BATCH // 8)
+    else:
+        np_ = getattr(zoo, model)(num_classes=spec["classes"],
+                                  with_accuracy=False)
+        shapes = {"data": (_BATCH // 8, spec["channels"], spec["image"],
+                           spec["image"]),
+                  "label": (_BATCH // 8,)}
+    return Net(np_, "TRAIN", source_shapes=shapes), spec
+
+
+def ensure_virtual_mesh() -> None:
+    """Pin the measurement substrate BEFORE jax initializes: the 8-device
+    virtual CPU mesh every tier-1 suite runs on (tests/conftest.py). A
+    contract measured on a different device count has different collective
+    groups and is not comparable — if jax is already up with another
+    count, check_model refuses the comparison (ContractEnvironmentError,
+    CLI exit 4), never reporting it as a violation."""
+    import sys
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def build_contract(model: str) -> Dict:
+    """Compile (on the current backend) and measure one model's contract.
+    Slow path: seconds of tracing per model; LeNet additionally runs the
+    CPU XLA compile for the optimized-HLO section."""
+    ensure_virtual_mesh()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel import (CommConfig, build_train_step, init_train_state,
+                            make_mesh)
+    from ..proto.messages import SolverParameter
+    from ..runtime.hlo_comm import count_gradient_all_reduces_stablehlo
+    from ..runtime.hlo_layout import (count_layout_transposes,
+                                      net_transpose_report)
+
+    net, spec = _build_net(model)
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0005)
+    mesh = make_mesh()
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    params = net.init(jax.random.PRNGKey(0))
+    cc = CommConfig()
+    ts = build_train_step(net, sp, mesh, cc, donate=True, donate_batch=True)
+    state = init_train_state(params, cc, n_dev)
+    rs = np.random.RandomState(0)
+    shape = (_BATCH, spec["channels"], spec["image"], spec["image"])
+    batch = {"data": jnp.asarray(rs.randn(*shape).astype(np.float32)),
+             "label": jnp.asarray(rs.randint(0, spec["classes"],
+                                             size=(_BATCH,)))}
+    lowered = ts.lowerable.lower(params, state, batch, jax.random.PRNGKey(7))
+    txt = lowered.as_text()
+    census = _dtype_census(txt)
+    arena_buckets = ts.arena.n_buckets if ts.arena is not None else None
+    contract: Dict = {
+        "model": model,
+        "generated_with": {"jax": jax.__version__,
+                           "backend": jax.default_backend(),
+                           "n_devices": n_dev},
+        "config": {"image": spec["image"], "channels": spec["channels"],
+                   "batch": _BATCH, "num_classes": spec["classes"],
+                   "conv_layout": net.conv_layout,
+                   "param_arena": cc.param_arena,
+                   "arena_bucket_mb": cc.arena_bucket_mb,
+                   "arena_buckets": arena_buckets,
+                   "donate": True, "donate_batch": True},
+        "stablehlo": {
+            # the PR-4 acceptance counter: bucketed psums, never per-leaf
+            "gradient_all_reduces": count_gradient_all_reduces_stablehlo(txt),
+            # the PR-3 counter under the default (per-backend) layout
+            "layout_transposes": count_layout_transposes(txt),
+            # PR-5: params + solver state + batch buffers all donated
+            "donated_buffers": txt.count("jax.buffer_donor"),
+            "f64_tensors": census.get("f64", 0),
+            "dtype_census": census,
+        },
+    }
+    if spec["nhwc"]:
+        from ..core.net import Net
+        img = spec.get("nhwc_image", spec["image"])
+        nhwc_net = Net(net.net_param, "TRAIN",
+                       {"data": (2, spec["channels"], img, img),
+                        "label": (2,)},
+                       conv_layout="NHWC")
+        rep = net_transpose_report(nhwc_net, sp, per_dev_batch=2,
+                                   image=img)
+        contract["nhwc"] = {
+            "level": rep["level"],
+            # the PR-3 headline: exactly the fc-boundary pair on AlexNet
+            "layout_transposes": rep["layout_transposes"],
+        }
+    if spec["optimized"]:
+        compiled = lowered.compile()
+        ctxt = compiled.as_text()
+        from ..runtime.hlo_comm import count_gradient_all_reduces
+        contract["optimized"] = {
+            "gradient_all_reduces": count_gradient_all_reduces(ctxt),
+            "layout_transposes": count_layout_transposes(ctxt),
+            "fusion_count": _fusion_count(ctxt),
+        }
+    return contract
+
+
+def load_contract(model: str) -> Optional[Dict]:
+    path = contract_path(model)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def diff_contracts(golden: Dict, fresh: Dict) -> List[str]:
+    """Human-readable mismatches, empty when the contract holds. Pure —
+    the unit tests feed it synthetic violations without compiling."""
+    diffs: List[str] = []
+    same_jax = (golden.get("generated_with", {}).get("jax")
+                == fresh.get("generated_with", {}).get("jax"))
+    g_dev = golden.get("generated_with", {}).get("n_devices")
+    f_dev = fresh.get("generated_with", {}).get("n_devices")
+    if g_dev != f_dev:
+        return [f"n_devices: golden measured on {g_dev}, this process has "
+                f"{f_dev} — collective groups are not comparable (run "
+                f"under the 8-device virtual mesh, see "
+                f"contracts.ensure_virtual_mesh)"]
+
+    def cmp(section: str, key: str, robust: bool) -> None:
+        g = golden.get(section, {}).get(key)
+        f = fresh.get(section, {}).get(key)
+        if g is None:
+            return
+        if not same_jax and not robust:
+            return
+        if g != f:
+            diffs.append(f"{section}.{key}: golden {g!r} != measured {f!r}")
+
+    for section in ("stablehlo", "nhwc", "optimized"):
+        gsec = golden.get(section)
+        if gsec is None:
+            continue
+        if section == "optimized" and fresh.get(section) is None:
+            diffs.append("optimized: section missing from measurement")
+            continue
+        for key in gsec:
+            # nothing in the optimized-HLO section is robust: those
+            # counters are compiler output (layout assignment, fusion),
+            # exact only under the recorded jax version
+            cmp(section, key, robust=(key in ROBUST_KEYS
+                                      and section != "optimized"))
+    # donation is robust as a non-emptiness claim even across jax versions
+    # (under the SAME version the exact compare above already covers it)
+    if not same_jax:
+        g_don = golden.get("stablehlo", {}).get("donated_buffers")
+        f_don = fresh.get("stablehlo", {}).get("donated_buffers")
+        if g_don and not f_don:
+            diffs.append(f"stablehlo.donated_buffers: golden {g_don} but "
+                         f"the measured program donates nothing")
+    if not same_jax and diffs:
+        diffs.append(
+            f"note: golden generated under jax "
+            f"{golden.get('generated_with', {}).get('jax')!r}, running "
+            f"{fresh.get('generated_with', {}).get('jax')!r} — only the "
+            f"robust counter subset was compared")
+    return diffs
+
+
+def check_model(model: str,
+                fresh: Optional[Dict] = None) -> Tuple[bool, List[str]]:
+    golden = load_contract(model)
+    if golden is None:
+        return False, [f"no checked-in contract for {model!r} "
+                       f"(run --refresh-contracts)"]
+    fresh = fresh or build_contract(model)
+    g_dev = golden.get("generated_with", {}).get("n_devices")
+    f_dev = fresh.get("generated_with", {}).get("n_devices")
+    if g_dev != f_dev:
+        raise ContractEnvironmentError(
+            f"{model}: golden measured on {g_dev} devices, this process "
+            f"has {f_dev} — collective groups are not comparable (run "
+            f"under the 8-device virtual mesh, see "
+            f"contracts.ensure_virtual_mesh)")
+    diffs = diff_contracts(golden, fresh)
+    return not diffs, diffs
+
+
+def check_all(models: Sequence[str] = MODELS) -> Tuple[bool, Dict]:
+    report: Dict = {}
+    ok = True
+    for m in models:
+        m_ok, diffs = check_model(m)
+        report[m] = {"ok": m_ok, "diffs": diffs}
+        ok = ok and m_ok
+    return ok, report
+
+
+def refresh(models: Sequence[str] = MODELS, out=print) -> None:
+    """Rewrite the goldens, printing old->new for review — a contract
+    change must be a decision, never an accident."""
+    os.makedirs(CONTRACT_DIR, exist_ok=True)
+    for m in models:
+        fresh = build_contract(m)
+        old = load_contract(m)
+        if old is not None:
+            for d in diff_contracts(old, fresh):
+                out(f"  {m}: {d}")
+        with open(contract_path(m), "w") as f:
+            json.dump(fresh, f, indent=2)
+            f.write("\n")
+        out(f"refreshed {contract_path(m)}")

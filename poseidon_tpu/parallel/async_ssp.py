@@ -620,6 +620,11 @@ class AsyncSSPClient:
         # workers de-synchronize their retries by construction)
         self._rng = random.Random(0xA5 ^ worker)
         self._stop = threading.Event()
+        # reconnect episodes are counted from BOTH channels — the sender
+        # thread's push recovery and the training thread's pull recovery —
+        # so the increment needs its own lock (THR004; membership
+        # telemetry reads it concurrently)
+        self._stats_lock = threading.Lock()
         self.reconnects = 0
         # initial connect: the service may come up AFTER the workers under
         # a real launcher — retry_s is the rendezvous deadline
@@ -701,7 +706,8 @@ class AsyncSSPClient:
             # the service's anchor), and a drain() caller observing them
             # must also observe the reconnect counter
             if not counted:
-                self.reconnects += 1
+                with self._stats_lock:
+                    self.reconnects += 1
                 counted = True
             try:
                 out = body(sk)

@@ -548,7 +548,9 @@ class Engine:
                 else out
         return self.train_step.step(self.params, self.state, batch, rng)
 
-    def _resolve_aot_step(self, batch, rng) -> None:
+    # one-time AOT resolution at the FIRST dispatch (key hashing over
+    # static shapes/mesh ints), never steady-state:
+    def _resolve_aot_step(self, batch, rng) -> None:  # static-ok: JIT102
         """Load — or compile + serialize — the step executable for this
         exact (model, shapes, mesh, backend, policy) key. Best-effort:
         any failure pins the jit path for the rest of the run (which the

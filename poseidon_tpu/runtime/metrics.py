@@ -217,7 +217,7 @@ class MetricsServer:
         self._thread.join(timeout=5.0)
 
 
-def scalar_rows(metrics: Dict) -> List[Dict[str, float]]:
+def scalar_rows(metrics: Dict) -> List[Dict[str, float]]:  # static-ok: JIT102
     """Materialize one dispatch's device metrics into float rows, one per
     optimizer step. Single-step dispatches hold scalars (one row);
     scan-chunk dispatches hold [K]-stacked arrays (K rows). ``np.asarray``

@@ -155,9 +155,10 @@ class DynamicBatcher:
 
     def fill_ratio(self) -> Optional[float]:
         """Mean rows/max_batch over all flushed micro-batches."""
-        if not self.batches:
-            return None
-        return self._fill_sum / self.batches
+        with self._lock:      # paired read: both fields from one flush
+            if not self.batches:
+                return None
+            return self._fill_sum / self.batches
 
     # ---- flush side ------------------------------------------------------ #
     def _take_batch(self) -> Optional[List[_Pending]]:
@@ -198,7 +199,11 @@ class DynamicBatcher:
                 if r.cancelled:
                     continue        # submitter timed out; nobody listens
                 if r.deadline is not None and now > r.deadline:
-                    self.deadline_expired += 1
+                    # counter shared with the handler threads' /stats
+                    # reads and submit's shed accounting — same lock as
+                    # the rest of the telemetry (THR004)
+                    with self._lock:
+                        self.deadline_expired += 1
                     r.error = DeadlineError(
                         f"deadline expired after "
                         f"{now - r.enqueued:.3f}s in queue")
@@ -219,9 +224,13 @@ class DynamicBatcher:
                     r.error = e
                     r.event.set()
                 continue
-            self.batches += 1
-            self.batched_rows += rows
-            self._fill_sum += rows / self.max_batch
+            # flush-thread counters race the /stats handler threads (and
+            # fill_ratio's two-field read) without the lock: a lost
+            # increment here understates load forever (THR004)
+            with self._lock:
+                self.batches += 1
+                self.batched_rows += rows
+                self._fill_sum += rows / self.max_batch
             off = 0
             for r in live:
                 r.result = {

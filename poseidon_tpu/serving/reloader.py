@@ -66,13 +66,18 @@ class CheckpointReloader:
             except Exception as e:  # noqa: BLE001 — the watcher must survive
                 # discovery itself failed (unreadable watch dir, NFS
                 # outage): as loud as a failed load, or hot-reload dies
-                # silently while the operator believes it is live
+                # silently while the operator believes it is live.
+                # Counter + last_error under the SAME lock check_now's
+                # load-failure path uses — the unlocked twin of a locked
+                # mutation loses increments (THR006)
                 err = f"{type(e).__name__}: {e}"
-                if err != self.last_error:
+                with self._lock:
+                    changed = err != self.last_error
+                    self.last_error = err
+                    self.failed_reloads += 1
+                if changed:
                     log(f"serving: snapshot watch on {self.prefix!r} "
                         f"failing: {err}")
-                self.last_error = err
-                self.failed_reloads += 1
 
     def check_now(self) -> bool:
         """One poll: if a snapshot newer than the one serving exists, load

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""CI entry point for the static guardrails (ISSUE 8).
+
+    python scripts/check_static.py --fail-on-new [--report out.json]
+    python scripts/check_static.py --contracts all
+
+A thin wrapper over ``python -m poseidon_tpu.analysis`` that (a) works
+from a bare checkout without installing the package (it prepends the repo
+root to sys.path) and (b) defaults the report path so the CI step always
+uploads an artifact. The default invocation is jax-free; ``--contracts``
+pins the 8-device virtual CPU mesh before jax initializes so the counters
+are comparable with the checked-in goldens.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if any(a.startswith("--contracts") or a.startswith("--refresh-contracts")
+           for a in argv):
+        from poseidon_tpu.analysis.contracts import ensure_virtual_mesh
+        ensure_virtual_mesh()
+    if not any(a.startswith("--report") for a in argv):
+        argv = ["--report", os.path.join(REPO, "static_findings.json")] + argv
+    from poseidon_tpu.analysis.__main__ import main as analysis_main
+    return analysis_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

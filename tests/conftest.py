@@ -28,8 +28,69 @@ import jax  # noqa: E402
 if not _ON_TPU:
     jax.config.update("jax_platforms", "cpu")
 
+import faulthandler  # noqa: E402
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# ---- thread sanitizer (ISSUE 8 satellite) -------------------------------- #
+# A wedged suite dumps every stack on SIGABRT/timeout instead of dying mute.
+faulthandler.enable()
+
+# Uncaught exceptions on background threads historically vanished into
+# stderr while the test that caused them passed. Record them and fail the
+# test they happened under (mark `allow_thread_exceptions` for tests that
+# intentionally kill threads rudely).
+_THREAD_ERRORS = []          # (thread object, rendered message)
+_ORIG_EXCEPTHOOK = threading.excepthook
+
+
+def _failing_excepthook(args):
+    # keep the Thread OBJECT, not its ident: CPython recycles idents, so
+    # an ident-keyed filter could blame (or absolve) the wrong thread
+    _THREAD_ERRORS.append((args.thread,
+                           f"{getattr(args.thread, 'name', '?')}: "
+                           f"{args.exc_type.__name__}: {args.exc_value}"))
+    _ORIG_EXCEPTHOOK(args)
+
+
+threading.excepthook = _failing_excepthook
+
+
+@pytest.fixture(autouse=True)
+def _thread_sanitizer(request):
+    """Per-test teardown gate: no uncaught background-thread exception,
+    and no NEW non-daemon thread may survive the test (a leaked
+    non-daemon thread wedges interpreter shutdown — the repo's own
+    threads are all daemonic by policy, so survivors are test bugs).
+
+    Only exceptions from threads STARTED during this test fail it: a
+    daemon thread from an earlier test dying late must not be blamed on
+    whichever test happens to be running when it unwinds."""
+    errs_before = len(_THREAD_ERRORS)
+    before = set(threading.enumerate())
+    yield
+    # run BOTH checks before failing: a test whose thread raises AND
+    # wedges must still get its leak joined/reported, or the survivor
+    # haunts later tests unattributed
+    problems = []
+    new_errs = [msg for t, msg in _THREAD_ERRORS[errs_before:]
+                if t not in before]
+    if new_errs and not request.node.get_closest_marker(
+            "allow_thread_exceptions"):
+        problems.append("uncaught exception on background thread(s): "
+                        + "; ".join(new_errs))
+    leaked = [t for t in threading.enumerate()
+              if not t.daemon and t.is_alive() and t not in before]
+    for t in leaked:
+        t.join(timeout=2.0)     # grace: racing a clean close() is fine
+    leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        problems.append("non-daemon thread(s) leaked by test: "
+                        + ", ".join(t.name for t in leaked))
+    if problems:
+        pytest.fail("; ".join(problems), pytrace=False)
 
 
 @pytest.fixture
