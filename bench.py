@@ -1278,7 +1278,18 @@ def _attr_one(model: str, per_dev_batch: int, iters: int, classes: int,
                          cost_table=A.layer_cost_table(net),
                          peak_flops=peak,
                          tracer_overhead_ms=overhead_ms)
+    # comm time per mesh axis: the spmd/arena collective scopes
+    # (grad_rs_bucket<i> on fsdp, grad_ar_bucket<i>/grad_sync_bucket<i>
+    # on data, tp_* on tp) carry their axis in the name — attribute it
+    # instead of leaving collectives in the residual row
+    comm_by_axis: dict = {}
+    for r in result["rows"]:
+        ax = A.comm_axis_of(r["layer"])
+        if ax:
+            comm_by_axis[ax] = round(
+                comm_by_axis.get(ax, 0.0) + r["total_ms"], 4)
     doc = {
+        "comm_ms_by_axis": comm_by_axis,
         "model": model,
         "per_device_batch": per_dev_batch,
         "step_ms_timed": timing["step_ms"],
@@ -1401,10 +1412,208 @@ def attribution_main(argv: list) -> None:
     })
 
 
+# --------------------------------------------------------------------------- #
+# mesh mode: `python bench.py mesh` — replicated vs fsdp vs tp A/B
+# --------------------------------------------------------------------------- #
+
+def mesh_main(argv: list) -> None:
+    """`bench.py mesh`: the sharding planner's A/B (ROADMAP item 1).
+
+    For AlexNet, time one optimizer step under {replicated, fsdp, tp}
+    arms on the SAME device count and record each arm's lowered
+    collective census against the planned schedule, plus the fsdp arm's
+    per-device persistent state bytes (sharded-state layout) vs
+    replicated. For the GPT-small LM, lower the dp2 x tp4 step
+    (models/transformer.py) and diff its census against the comm bill on
+    record in evidence/aot_tpu/lm_gpt_small.json. CPU runs are labeled
+    proxy — step times re-measure on TPU when the tunnel returns; the
+    census and byte counts are backend-independent."""
+    import argparse
+    import time as _t
+
+    ap = argparse.ArgumentParser(prog="bench.py mesh")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="global batch (0 = 8 on cpu, 256 on tpu)")
+    ap.add_argument("--image", type=int, default=0,
+                    help="AlexNet image size (0 = 67 on cpu, 227 on tpu)")
+    ap.add_argument("--out", default=os.path.join(_REPO, "evidence",
+                                                  "mesh_ab.json"))
+    args = ap.parse_args(argv)
+
+    cpu_ok = os.environ.get("POSEIDON_BENCH_CPU", "") == "1"
+    on_accel = False
+    if not cpu_ok:
+        probe = probe_backend(
+            float(os.environ.get("POSEIDON_BENCH_PROBE_TIMEOUT", "60")), 1)
+        on_accel = probe.get("platform") in ("tpu", "axon")
+    import jax
+    if not on_accel:
+        # the mesh A/B is structural evidence (census + bytes) plus proxy
+        # step times; force the 8-device virtual CPU mesh
+        os.environ.setdefault("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in \
+                os.environ["XLA_FLAGS"]:
+            os.environ["XLA_FLAGS"] = (
+                os.environ["XLA_FLAGS"]
+                + " --xla_force_host_platform_device_count=8").strip()
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    from poseidon_tpu.config import MeshConfig
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import CommConfig, init_train_state
+    from poseidon_tpu.parallel.spmd import (ShardingPlan,
+                                            build_spmd_train_step,
+                                            named_mesh, shard_train_state)
+    from poseidon_tpu.proto.messages import SolverParameter
+    from poseidon_tpu.runtime.hlo_comm import collective_census_stablehlo
+
+    image = args.image or (227 if on_accel else 67)
+    batch = args.batch or (256 if on_accel else 8)
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0005)
+    comm = CommConfig()
+    rs = np.random.RandomState(0)
+    doc: dict = {"backend": jax.default_backend(),
+                 "cpu_proxy": not on_accel,
+                 "alexnet": {}, "image": image, "global_batch": batch}
+
+    arms = (("replicated", "dp2,fsdp2", dict(shard_params=False)),
+            ("fsdp2", "dp2,fsdp2", {}),
+            ("tp2", "dp2,tp2", {}))
+    for arm, spec, plan_kw in arms:
+        cfg = MeshConfig.parse(spec)
+        mesh = named_mesh(cfg)
+        n_dp = cfg.data * cfg.fsdp
+        net = Net(zoo.alexnet(num_classes=1000, with_accuracy=False),
+                  phase="TRAIN",
+                  source_shapes={"data": (batch // n_dp, 3, image, image),
+                                 "label": (batch // n_dp,)})
+        plan = ShardingPlan.build(net, cfg, comm, **plan_kw)
+        ts = build_spmd_train_step(net, sp, mesh, plan, comm,
+                                   donate=False)
+        params = net.init(jax.random.PRNGKey(0))
+        state = init_train_state(params, comm, plan.n_dp)
+        feed = {"data": jnp.asarray(rs.randn(batch, 3, image, image)
+                                    .astype(np.float32)),
+                "label": jnp.asarray(rs.randint(0, 1000, size=(batch,)))}
+        rng = jax.random.PRNGKey(1)
+        lowered = ts.lowerable.lower(params, state, feed, rng)
+        census = collective_census_stablehlo(lowered.as_text())
+        sched = plan.collective_schedule(ts.arena, net, comm=comm)
+        p, s = params, state
+        walls = []
+        for i in range(max(1, args.iters) + 1):   # first call compiles
+            t0 = _t.perf_counter()
+            p, s, m = ts.step(p, s, feed, jax.random.fold_in(rng, i))
+            jax.block_until_ready(m["loss"])
+            walls.append(_t.perf_counter() - t0)
+        row = {"mesh": spec, "plan": plan.describe(),
+               "step_ms": round(min(walls[1:]) * 1e3, 2),
+               "images_per_s": round(batch / min(walls[1:]), 1),
+               "lowered_census": census,
+               "planned_counts": sched["counts"],
+               "census_matches_plan": census == sched["counts"]}
+        if arm == "fsdp2":
+            # persistent per-device param+grad+momentum bytes, sharded-
+            # state layout vs the replicated tree (the ZeRO footprint)
+            ts_sh = build_spmd_train_step(net, sp, mesh, plan, comm,
+                                          donate=False,
+                                          sharded_state=True)
+            st = shard_train_state(params, state, ts_sh.arena, mesh, plan)
+            shard_bytes = sum(
+                sh.data.nbytes
+                for arr in (st.flat_w, st.flat_h)
+                for sh in arr.addressable_shards[:1])
+            full_bytes = 2 * 4 * ts_sh.arena.total
+            row["arena_state_bytes_per_device"] = shard_bytes
+            row["arena_state_bytes_replicated"] = full_bytes
+            row["arena_state_fraction"] = round(
+                shard_bytes / full_bytes, 4)
+        doc["alexnet"][arm] = row
+        print(f"[mesh] alexnet/{arm}: {row['step_ms']} ms, census "
+              f"{census} (plan match: {row['census_matches_plan']})",
+              file=sys.stderr, flush=True)
+
+    # GPT-small dp2 x tp4: the comm bill already on record
+    try:
+        from poseidon_tpu import config as pconfig
+        from poseidon_tpu.models.transformer import (
+            build_dp_tp_train_step, gpt_small_config, init_params,
+            to_tp_layout)
+        from poseidon_tpu.parallel import make_mesh
+        from poseidon_tpu.runtime.hlo_comm import (measured_comm_summary,
+                                                   parse_collectives)
+        from poseidon_tpu.solvers.updates import init_state
+        mesh8 = make_mesh(8, axes=("data", "model"), shape=(2, 4))
+        seq = 1024 if on_accel else 128
+        gbatch = 16 if on_accel else 4
+        cfg_lm = gpt_small_config(max_seq=seq)
+        with pconfig.policy_scope(compute_dtype=jnp.bfloat16):
+            lp = to_tp_layout(init_params(cfg_lm, jax.random.PRNGKey(0)),
+                              cfg_lm)
+            step = build_dp_tp_train_step(cfg_lm, sp, mesh8, lp,
+                                          donate=False)
+            ls = init_state(lp)
+            toks = jnp.asarray(rs.randint(0, cfg_lm.vocab_size,
+                                          (gbatch, seq), dtype=np.int32))
+            txt = step.lower(lp, ls, toks, toks,
+                             jax.random.PRNGKey(1)).as_text()
+        lm_census = collective_census_stablehlo(txt)
+        lm_row: dict = {"mesh": "dp2,tp4", "seq": seq,
+                        "global_batch": gbatch,
+                        "lowered_census": lm_census}
+        ref_path = os.path.join(_REPO, "evidence", "aot_tpu",
+                                "lm_gpt_small.json")
+        if os.path.exists(ref_path):
+            with open(ref_path) as fh:
+                ref = json.load(fh)
+            lm_row["aot_reference_dp2_tp4"] = \
+                ref.get("dp2_tp4", {}).get("collectives_by_kind")
+        doc["gpt_small_dp2_tp4"] = lm_row
+        print(f"[mesh] gpt_small dp2,tp4: {lm_census}", file=sys.stderr,
+              flush=True)
+    except Exception as e:  # noqa: BLE001 — LM leg is evidence, not gate
+        doc["gpt_small_dp2_tp4"] = {"error": f"{type(e).__name__}: {e}"}
+
+    try:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1)
+        os.replace(tmp, args.out)
+    except OSError as e:
+        print(f"[bench] mesh out write failed: {e}", file=sys.stderr,
+              flush=True)
+
+    fsdp = doc["alexnet"].get("fsdp2", {})
+    all_match = all(r.get("census_matches_plan")
+                    for r in doc["alexnet"].values())
+    emit({
+        "metric": "mesh_arena_state_fraction",
+        "value": fsdp.get("arena_state_fraction", 0.0),
+        "unit": "fraction_of_replicated",
+        "vs_baseline": (0.5 / fsdp["arena_state_fraction"]
+                        if fsdp.get("arena_state_fraction") else 0.0),
+        "census_matches_plan": all_match,
+        "cpu_proxy": not on_accel,
+        "out": args.out,
+        "alexnet": {a: {"step_ms": r.get("step_ms"),
+                        "census": r.get("lowered_census")}
+                    for a, r in doc["alexnet"].items()},
+    })
+    if not all_match:
+        sys.exit(1)
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "attribution":
         attribution_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "mesh":
+        mesh_main(sys.argv[2:])
     else:
         main()

@@ -49,20 +49,29 @@ MODELS = ("lenet", "alexnet", "googlenet")
 # NHWC half runs at the real 227 px: at toy sizes pool5 degenerates to
 # 1x1 and the fc6 boundary pair it exists to pin folds away as bitcasts.
 _SPECS = {
+    # "mesh": lower the dp2xfsdp2xtp2 sharding-planner step and pin its
+    # collective census against the planned schedule (parallel/spmd.py).
+    # GoogLeNet skips it for compile budget — its schedule shape (conv
+    # arena buckets + gathered-column classifier heads) is covered by
+    # AlexNet, and its arena bucket count is already pinned above.
     "lenet": {"image": 28, "channels": 1, "classes": 10,
-              "optimized": True, "nhwc": False},
+              "optimized": True, "nhwc": False, "mesh": True},
     "alexnet": {"image": 67, "channels": 3, "classes": 10,
-                "optimized": False, "nhwc": True, "nhwc_image": 227},
+                "optimized": False, "nhwc": True, "nhwc_image": 227,
+                "mesh": True},
     "googlenet": {"image": 224, "channels": 3, "classes": 10,
-                  "optimized": False, "nhwc": False},
+                  "optimized": False, "nhwc": False, "mesh": False},
 }
 
 _BATCH = 8          # one row per device on the 8-device virtual mesh
 
 # exact-compare keys that survive jax upgrades (program-level, not
 # compiler-whim-level); everything else is exact only under the recorded
-# jax version
-ROBUST_KEYS = ("gradient_all_reduces", "layout_transposes", "f64_tensors")
+# jax version. The collective_schedule keys are structural — the planner
+# states them and lowering preserves them (chained buckets cannot merge).
+ROBUST_KEYS = ("gradient_all_reduces", "layout_transposes", "f64_tensors",
+               "mesh", "arena_buckets", "tp_modes", "planned_counts",
+               "lowered_counts", "planned_matches_lowered")
 
 _TENSOR_DTYPE_RE = re.compile(r"tensor<[0-9x]*([a-z][a-z0-9]*)>")
 
@@ -191,6 +200,47 @@ def build_contract(model: str) -> Dict:
             # the PR-3 headline: exactly the fc-boundary pair on AlexNet
             "layout_transposes": rep["layout_transposes"],
         }
+    if spec.get("mesh"):
+        # ROADMAP item 1's extension: the SPMD sharding planner's
+        # collective schedule, pinned exactly like the arena's buckets.
+        # dp2 x fsdp2 x tp2 uses all 8 virtual devices; counted on the
+        # LOWERED program (combiner-proof: the chained buckets cannot
+        # merge, and XLA never splits a collective).
+        from ..config import MeshConfig
+        from ..core.net import Net
+        from ..parallel.spmd import (ShardingPlan, build_spmd_train_step,
+                                     named_mesh)
+        from ..runtime.hlo_comm import collective_census_stablehlo
+        mcfg = MeshConfig(data=2, fsdp=2, tp=2)
+        smesh = named_mesh(mcfg)
+        n_dp = mcfg.data * mcfg.fsdp
+        if model == "lenet":
+            from ..models import zoo as _zoo
+            mshapes = _zoo.lenet_shapes(_BATCH // n_dp)
+        else:
+            mshapes = {"data": (_BATCH // n_dp, spec["channels"],
+                                spec["image"], spec["image"]),
+                       "label": (_BATCH // n_dp,)}
+        mnet = Net(net.net_param, "TRAIN", source_shapes=mshapes)
+        plan = ShardingPlan.build(mnet, mcfg, cc)
+        mts = build_spmd_train_step(mnet, sp, smesh, plan, cc,
+                                    donate=False)
+        mparams = mnet.init(jax.random.PRNGKey(0))
+        mstate = init_train_state(mparams, cc, n_dp)
+        mlowered = mts.lowerable.lower(mparams, mstate, batch,
+                                      jax.random.PRNGKey(7))
+        census = collective_census_stablehlo(mlowered.as_text())
+        sched = plan.collective_schedule(mts.arena, mnet, comm=cc)
+        contract["collective_schedule"] = {
+            "mesh": mcfg.describe(),
+            "arena_buckets": (mts.arena.n_buckets
+                              if mts.arena is not None else 0),
+            "tp_modes": {l: d.mode
+                         for l, d in sorted(plan.tp_layers.items())},
+            "planned_counts": sched["counts"],
+            "lowered_counts": census,
+            "planned_matches_lowered": census == sched["counts"],
+        }
     if spec["optimized"]:
         compiled = lowered.compile()
         ctxt = compiled.as_text()
@@ -235,7 +285,8 @@ def diff_contracts(golden: Dict, fresh: Dict) -> List[str]:
         if g != f:
             diffs.append(f"{section}.{key}: golden {g!r} != measured {f!r}")
 
-    for section in ("stablehlo", "nhwc", "optimized"):
+    for section in ("stablehlo", "nhwc", "collective_schedule",
+                    "optimized"):
         gsec = golden.get(section)
         if gsec is None:
             continue

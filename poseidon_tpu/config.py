@@ -33,6 +33,78 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
+@dataclass(frozen=True)
+class MeshConfig:
+    """Named-axis SPMD mesh request (``--mesh dp2,fsdp2,tp1``).
+
+    Three axes, all data-independent mechanisms (parallel/spmd.py):
+      ``data`` — classic data parallelism (replicated params, batch shards);
+      ``fsdp`` — batch shards PLUS parameter-arena sharding: arena buckets
+                 live 1/fsdp per device, gradients reduce-scatter, params
+                 all-gather (the ZeRO trade);
+      ``tp``   — tensor parallelism: FC layers take column/row weight
+                 shards, activations reshard at planner-chosen points.
+    Sizes of 1 deactivate an axis. Dependency-free (parsed before jax
+    loads); ``parallel.spmd.named_mesh`` turns it into a jax Mesh."""
+
+    data: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    # False = the replicated CONTROL arm on the same mesh (same batch
+    # shards, same hierarchical reduction order, sharding mechanism off)
+    # — the A/B the bitwise parity acceptance compares against. Spelled
+    # ``--mesh dp2,fsdp2,replicated``.
+    shard: bool = True
+
+    _KEYS = (("dp", "data"), ("data", "data"), ("fsdp", "fsdp"),
+             ("tp", "tp"))
+
+    @classmethod
+    def parse(cls, spec: str) -> "MeshConfig":
+        """``"dp2,fsdp2,tp1"`` (any subset, any order) -> MeshConfig.
+        Unknown axis names and repeated axes fail loudly; a trailing
+        ``replicated`` token selects the control arm."""
+        sizes = {}
+        shard = True
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            if part == "replicated":
+                shard = False
+                continue
+            for key, axis in cls._KEYS:
+                if part.startswith(key) and part[len(key):].isdigit():
+                    if axis in sizes:
+                        raise ValueError(
+                            f"--mesh {spec!r}: axis {axis!r} given twice")
+                    sizes[axis] = int(part[len(key):])
+                    break
+            else:
+                raise ValueError(
+                    f"--mesh {spec!r}: cannot parse {part!r} (expected "
+                    f"dpN / fsdpN / tpN or 'replicated', e.g. "
+                    f"'dp2,fsdp2,tp1')")
+        cfg = cls(shard=shard, **{k: v for k, v in sizes.items()})
+        for name, size in (("data", cfg.data), ("fsdp", cfg.fsdp),
+                           ("tp", cfg.tp)):
+            if size < 1:
+                raise ValueError(f"--mesh {spec!r}: {name} size must be "
+                                 f">= 1, got {size}")
+        return cfg
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.fsdp * self.tp
+
+    @property
+    def active(self) -> bool:
+        """True when the request needs the SPMD planner (any sharding
+        beyond plain data parallelism)."""
+        return self.fsdp > 1 or self.tp > 1
+
+    def describe(self) -> str:
+        return (f"dp{self.data},fsdp{self.fsdp},tp{self.tp}"
+                + ("" if self.shard else ",replicated"))
+
+
 @dataclass
 class FaultConfig:
     """Fault-tolerance policy for the host-driven async-SSP process tier.

@@ -65,21 +65,35 @@ class ArenaLayout:
     ops it emits at trace time are slices, reshapes and concatenates."""
 
     def __init__(self, slots: Sequence[ArenaSlot],
-                 bucket_mb: Optional[float]):
+                 bucket_mb: Optional[float], align: int = 1):
         if not slots:
             raise ValueError("empty arena")
         self.slots: Tuple[ArenaSlot, ...] = tuple(slots)
         self.total = slots[-1].offset + slots[-1].size
         self.dtype = jnp.float32
         itemsize = 4
+        # ``align`` > 1 (the SPMD mesh's fsdp shard count, parallel/spmd.py):
+        # every bucket boundary snaps to a multiple of align and the buffer
+        # is zero-padded up to one, so each bucket splits into exactly
+        # align equal shards (reduce-scatter / all-gather operands). The
+        # padding tail carries zero lr/decay multipliers — the fused update
+        # leaves it at zero — and pack/unpack ignore it, so the logical
+        # (canonical per-leaf) contract is unchanged.
+        self.align = max(1, int(align))
+        self.padded_total = -(-self.total // self.align) * self.align
         if bucket_mb is None or bucket_mb <= 0:
+            if self.align > 1:
+                raise ValueError(
+                    "per-leaf buckets (bucket_mb <= 0) cannot align to an "
+                    "fsdp shard count; use a positive bucket_mb")
             # per-leaf buckets (the dwbp_bucket_mb=0 convention)
             self.bucket_ranges = [(s.offset, s.offset + s.size)
                                   for s in self.slots]
         else:
             b = max(1, int(bucket_mb * 1e6) // itemsize)
-            self.bucket_ranges = [(lo, min(lo + b, self.total))
-                                  for lo in range(0, self.total, b)]
+            b = -(-b // self.align) * self.align
+            self.bucket_ranges = [(lo, min(lo + b, self.padded_total))
+                                  for lo in range(0, self.padded_total, b)]
         self.n_buckets = len(self.bucket_ranges)
         self.layers: FrozenSet[str] = frozenset(s.layer for s in self.slots)
         self._index = {(s.layer, s.pname): s for s in self.slots}
@@ -116,13 +130,17 @@ class ArenaLayout:
         return v
 
     def pack(self, tree: Tree) -> jax.Array:
-        """Per-leaf tree -> flat 1-D buffer, in slot (DWBP) order."""
+        """Per-leaf tree -> flat 1-D buffer (zero tail up to
+        ``padded_total`` under fsdp alignment), in slot (DWBP) order."""
         # named scopes here and below: xplane events from the pack/unpack
         # copies attribute to the arena phase, not to the residual row
         # (runtime/attribution.py joins these names back from op metadata)
         with jax.named_scope("arena_pack"):
-            return jnp.concatenate(
-                [self._leaf(tree, s).reshape(-1) for s in self.slots])
+            parts = [self._leaf(tree, s).reshape(-1) for s in self.slots]
+            if self.padded_total > self.total:
+                parts.append(jnp.zeros(self.padded_total - self.total,
+                                       self.dtype))
+            return jnp.concatenate(parts)
 
     def unpack(self, flat: jax.Array) -> Tree:
         """Flat buffer -> per-leaf tree (static slices + reshapes)."""
@@ -198,13 +216,21 @@ class ArenaLayout:
                 # copies between backward matmuls and the bucketed psums
                 with jax.named_scope("arena_grads"):
                     outs = []
-                    for pieces in layout._bucket_pieces:
+                    for bi, pieces in enumerate(layout._bucket_pieces):
                         parts = []
+                        covered = 0
                         for si, lo, hi in pieces:
                             s = layout.slots[si]
                             leaf_ct = ct[s.layer][s.pname].reshape(-1)
                             parts.append(lax.slice(leaf_ct, (lo - s.offset,),
                                                    (hi - s.offset,)))
+                            covered += hi - lo
+                        blo, bhi = layout.bucket_ranges[bi]
+                        if covered < bhi - blo:
+                            # alignment tail (no slot behind it): the bucket
+                            # cotangent must still be bucket-shaped
+                            parts.append(jnp.zeros(bhi - blo - covered,
+                                                   layout.dtype))
                         outs.append(parts[0] if len(parts) == 1 else
                                     jnp.concatenate(parts))
                     return tuple(outs)
@@ -219,9 +245,10 @@ class ArenaLayout:
         Each segment holds exactly the scalars the per-leaf update rule
         uses: f32(lr_mult) and f32(weight_decay * decay_mult) — the
         products taken in Python float first, like the per-leaf path, so
-        the fused pass is bit-identical."""
-        lr = np.zeros(self.total, np.float32)
-        dec = np.zeros(self.total, np.float32)
+        the fused pass is bit-identical. The alignment tail (if any) keeps
+        zero multipliers, so the fused update leaves it at zero."""
+        lr = np.zeros(self.padded_total, np.float32)
+        dec = np.zeros(self.padded_total, np.float32)
         for s in self.slots:
             lr[s.offset:s.offset + s.size] = np.float32(s.lr_mult)
             dec[s.offset:s.offset + s.size] = np.float32(
@@ -231,7 +258,8 @@ class ArenaLayout:
 
 def build_arena(order: Sequence[Tuple[str, object]],
                 include: FrozenSet[str],
-                bucket_mb: Optional[float]) -> Optional[ArenaLayout]:
+                bucket_mb: Optional[float],
+                align: int = 1) -> Optional[ArenaLayout]:
     """ArenaLayout over ``order`` — the Net's DWBP-ordered (layer, ParamDef)
     table — restricted to ``include`` layers. None when nothing qualifies.
     Both the trainer and any tool that needs to re-derive the layout call
@@ -246,4 +274,4 @@ def build_arena(order: Sequence[Tuple[str, object]],
         off += pdef.count
     if not slots:
         return None
-    return ArenaLayout(slots, bucket_mb)
+    return ArenaLayout(slots, bucket_mb, align=align)

@@ -236,19 +236,22 @@ class Net:
         self._plan_layouts()
 
     # ------------------------------------------------------------------ #
-    def arena_layout(self, include=None, bucket_mb: float = 4.0):
+    def arena_layout(self, include=None, bucket_mb: float = 4.0,
+                     align: int = 1):
         """The flat-parameter-arena layout over this net's DWBP-ordered
         offset table, restricted to ``include`` layers (default: all param
-        layers) and cut into ~``bucket_mb`` MB collective buckets. Cached
-        per (include, bucket_mb) so the trainer, tests and tools always
+        layers) and cut into ~``bucket_mb`` MB collective buckets, with
+        bucket boundaries aligned to ``align`` elements (the SPMD mesh's
+        fsdp shard count — parallel/spmd.py). Cached per
+        (include, bucket_mb, align) so the trainer, tests and tools always
         agree on offsets. Returns None when nothing qualifies."""
         from .arena import build_arena
         inc = frozenset(self.param_defs) if include is None \
             else frozenset(include)
-        key = (inc, bucket_mb)
+        key = (inc, bucket_mb, align)
         if key not in self._arena_layouts:
             self._arena_layouts[key] = build_arena(self._arena_order, inc,
-                                                   bucket_mb)
+                                                   bucket_mb, align=align)
         return self._arena_layouts[key]
 
     # ------------------------------------------------------------------ #
@@ -307,14 +310,29 @@ class Net:
                 if len(self.blob_shapes[t]) == 4:
                     cur[t] = run
 
-    def _layer_params(self, params, layer: Layer) -> Dict[str, jax.Array]:
+    def _layer_params(self, params, layer: Layer,
+                      comm=None) -> Dict[str, jax.Array]:
         """Resolve a layer's param dict through the sharing bindings."""
         out = {}
         for pdef in layer.params:
             olayer, opname = self._storage_of[(layer.name, pdef.name)]
             arr = params[olayer][opname]
-            if arr.shape != pdef.shape:  # PERMISSIVE share: same count
-                arr = arr.reshape(pdef.shape)
+            if arr.shape != pdef.shape:
+                if arr.size == pdef.count:
+                    # PERMISSIVE share: same count, different shape
+                    arr = arr.reshape(pdef.shape)
+                elif comm is not None and getattr(
+                        comm, "is_tp_leaf", lambda *_: False)(
+                            layer.name, pdef.name):
+                    # tensor-parallel shard (parallel/spmd.py): the
+                    # layer's comm hook consumes the local slice as-is
+                    pass
+                else:
+                    raise ValueError(
+                        f"layer {layer.name!r} param {pdef.name!r}: got "
+                        f"shape {tuple(arr.shape)} for defined shape "
+                        f"{tuple(pdef.shape)} — size mismatch with no "
+                        f"tensor-parallel plan covering this leaf")
             out[pdef.name] = arr
         return out
 
@@ -395,7 +413,8 @@ class Net:
                 bottoms = [bottom_in(b, layer.run_layout)
                            for b in lp.bottom]
                 tops = layer.apply(
-                    self._layer_params(params, layer) if layer.params else {},
+                    self._layer_params(params, layer, comm)
+                    if layer.params else {},
                     bottoms, ctx)
             weights = layer.loss_weights(len(tops))
             for name, val, w in zip(lp.top, tops, weights):

@@ -9,8 +9,13 @@ startup reads as silence to the service's liveness monitor.
 
 _LAZY = {
     # mesh
-    "DATA_AXIS": "mesh", "batch_sharding": "mesh", "make_mesh": "mesh",
-    "replicated": "mesh",
+    "DATA_AXIS": "mesh", "SPMD_AXES": "mesh", "balanced_shape": "mesh",
+    "batch_sharding": "mesh", "make_mesh": "mesh", "replicated": "mesh",
+    # spmd (sharding planner)
+    "ShardingPlan": "spmd", "SpmdState": "spmd",
+    "build_spmd_train_step": "spmd", "mesh_config_of": "spmd",
+    "named_mesh": "spmd", "shard_train_state": "spmd",
+    "unshard_train_state": "spmd",
     # strategies
     "CommConfig": "strategies", "CommContext": "strategies",
     "DENSE": "strategies", "DENSE_FUSED": "strategies",

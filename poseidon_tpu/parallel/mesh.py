@@ -2,9 +2,13 @@
 
 Where the reference enumerates machines from a hostfile and threads per GPU
 (``ps/src/petuum_ps/thread/context.hpp``, ``src/caffe/common.cpp:52-185``), the
-TPU runtime's topology is a ``jax.sharding.Mesh``. The parity scope is one
-"data" axis (pure data parallelism, §2.3 of SURVEY.md); helper supports extra
-axes for model/pipeline experiments.
+TPU runtime's topology is a ``jax.sharding.Mesh``. Two mesh shapes exist:
+
+- the flat ``("data",)`` mesh (pure data parallelism, §2.3 of SURVEY.md) —
+  the default every tier-1 suite runs on; and
+- the named SPMD mesh ``("data", "fsdp", "tp")`` built from a
+  ``config.MeshConfig`` (``--mesh dp2,fsdp2,tp1``), whose per-layer
+  PartitionSpec plan lives in ``parallel/spmd.py``.
 """
 
 from __future__ import annotations
@@ -16,21 +20,78 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+# named-axis order of the SPMD mesh (spmd.py): data-parallel groups, FSDP
+# shard groups (also data-parallel over the batch), tensor-parallel groups.
+# fsdp sits between data and tp so the fsdp collectives ride the
+# lower-latency inner groups on a real torus slice.
+SPMD_AXES = ("data", "fsdp", "tp")
+
+
+def balanced_shape(n: int, k: int) -> Tuple[int, ...]:
+    """Factor ``n`` devices into ``k`` mesh axes as evenly as possible:
+    prime factors of n are dealt largest-first onto the currently-smallest
+    axis. Deterministic, and never invents devices (prod == n). This is
+    the inferred default for multi-axis ``make_mesh`` calls without an
+    explicit shape — the old ``(n, 1, ...)`` default silently hung every
+    device on axis 0, which surprised every caller that meant a 2-D mesh."""
+    if k <= 0:
+        raise ValueError(f"need at least one axis, got {k}")
+    factors = []
+    m, p = n, 2
+    while p * p <= m:
+        while m % p == 0:
+            factors.append(p)
+            m //= p
+        p += 1
+    if m > 1:
+        factors.append(m)
+    shape = [1] * k
+    for f in sorted(factors, reverse=True):
+        shape[int(np.argmin(shape))] *= f
+    return tuple(sorted(shape, reverse=True))
 
 
 def make_mesh(
     num_devices: Optional[int] = None,
     axes: Sequence[str] = (DATA_AXIS,),
     shape: Optional[Tuple[int, ...]] = None,
+    devices: Optional[Sequence] = None,
 ) -> Mesh:
-    devices = jax.devices()
+    """Mesh over the first ``num_devices`` jax devices (all by default).
+
+    Fails loudly instead of guessing:
+    - asking for more devices than exist raises (the old ``devices[:n]``
+      slice silently truncated, and the run then trained on fewer replicas
+      than the operator sized the batch for);
+    - a multi-axis request without an explicit ``shape`` gets the balanced
+      factorization of the device count (``balanced_shape``) — pass
+      ``shape`` to choose the split yourself;
+    - a ``shape`` whose product is not the device count raises, naming
+      both sides.
+    """
+    devices = list(devices if devices is not None else jax.devices())
     if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"make_mesh: asked for {num_devices} devices but only "
+                f"{len(devices)} exist — a silently truncated mesh would "
+                f"train on fewer replicas than the batch was sized for")
+        if num_devices <= 0:
+            raise ValueError(f"make_mesh: num_devices must be positive, "
+                             f"got {num_devices}")
         devices = devices[:num_devices]
     n = len(devices)
     if shape is None:
-        shape = (n,) + (1,) * (len(axes) - 1)
+        shape = (n,) if len(axes) == 1 else balanced_shape(n, len(axes))
+    if len(shape) != len(axes):
+        raise ValueError(
+            f"make_mesh: shape {shape} has {len(shape)} dims for "
+            f"{len(axes)} axes {tuple(axes)}")
     if int(np.prod(shape)) != n:
-        raise ValueError(f"mesh shape {shape} != {n} devices")
+        raise ValueError(
+            f"make_mesh: mesh shape {shape} needs "
+            f"{int(np.prod(shape))} devices, have {n} "
+            f"(axes {tuple(axes)})")
     return Mesh(np.asarray(devices).reshape(shape), tuple(axes))
 
 

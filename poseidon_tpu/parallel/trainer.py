@@ -117,11 +117,13 @@ def comm_error_groups(comm: Optional[CommConfig], mesh: Mesh) -> int:
     """How many independent TOPK residuals exist: one per device on a flat
     mesh (local gradients differ), one per DCN slice on a two-tier mesh (the
     residual is computed from the intra-slice-summed gradient, identical on
-    every device of a slice)."""
+    every device of a slice). On a named SPMD mesh (parallel/spmd.py) tp
+    replicas share one residual — their gradients are identical — so the
+    count excludes the tp axis."""
     comm = comm or CommConfig()
     if comm.dcn_axis is not None:
         return mesh.shape[comm.dcn_axis]
-    return int(np.prod(list(mesh.shape.values())))
+    return int(np.prod([v for k, v in mesh.shape.items() if k != "tp"]))
 
 
 def build_train_step(
@@ -137,8 +139,19 @@ def build_train_step(
     input_transform: Optional[Callable] = None,
     iter_size: int = 1,
     input_layout: str = "NCHW",
+    plan=None,
 ) -> TrainStep:
     """Compiled SPMD train step over ``mesh``.
+
+    ``plan`` (a ``spmd.ShardingPlan``, from ``--mesh dp2,fsdp2,tp1``)
+    routes the build to the sharding-planner step: arena buckets
+    reduce-scatter over the fsdp axis, FC layers take the planned
+    column/row tp shards, and the step's collective schedule is the
+    plan's (parallel/spmd.py; the schedule is pinned by the
+    ``collective_schedule`` HLO contract section). The flat data-parallel
+    path below is unchanged when no plan is active. scan_steps /
+    iter_size / dump_blobs do not compose with a plan yet — the builder
+    rejects them loudly.
 
     ``input_layout="NHWC"`` declares that the caller feeds 4-D image blobs
     channels-last (after any ``input_transform``, which runs first); with
@@ -204,6 +217,17 @@ def build_train_step(
     no new device batch buffers. Callers that reuse a batch across calls
     (bench's ``scan_reuse_batch``) must keep the default False."""
     comm = comm or CommConfig()
+    if plan is not None and plan.active:
+        if scan_steps or iter_size > 1 or dump_blobs:
+            raise ValueError(
+                "--mesh (fsdp/tp sharding) does not compose with "
+                "scan_steps / iter_size / dump_blobs yet; run those on "
+                "the flat data mesh")
+        from .spmd import build_spmd_train_step
+        return build_spmd_train_step(
+            net, sp, mesh, plan, comm, donate=donate,
+            donate_batch=donate_batch, input_transform=input_transform,
+            input_layout=input_layout)
     comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
     dcn = comm.dcn_axis
@@ -544,8 +568,31 @@ def stack_batches(host_batches, sharding=None, lead_shape=None):
 
 
 def build_eval_step(net: Net, mesh: Mesh, axis: str = "data",
-                    dcn_axis: Optional[str] = None) -> Callable:
-    """Test-phase forward returning cross-replica-averaged scalar outputs."""
+                    dcn_axis: Optional[str] = None, plan=None) -> Callable:
+    """Test-phase forward returning cross-replica-averaged scalar outputs.
+
+    With a ``plan`` (named SPMD mesh) the batch shards jointly over
+    (data, fsdp) and tp replicas evaluate redundantly on replicated
+    canonical params — eval never needs the sharded step."""
+    if plan is not None and plan.active:
+        axes = ("data", "fsdp")
+        n_dev = plan.n_dp
+        batch_spec = P(axes)
+
+        def device_eval(params, batch):
+            out = net.apply(params, batch, train=False)
+            metrics = {}
+            if out.loss.ndim == 0:
+                metrics["loss"] = lax.psum(out.loss, axes) / n_dev
+            for name, val in out.outputs.items():
+                if val.ndim == 0:
+                    metrics[name] = lax.psum(val.astype(jnp.float32),
+                                             axes) / n_dev
+            return metrics
+
+        return jax.jit(shard_map(
+            device_eval, mesh=mesh,
+            in_specs=(P(), batch_spec), out_specs=P(), check_vma=False))
     axes = (dcn_axis, axis) if dcn_axis else (axis,)
     n_dev = int(np.prod([mesh.shape[a] for a in axes]))
     batch_spec = P(axes) if dcn_axis else P(axis)
@@ -600,6 +647,7 @@ def build_ssp_train_step(
     comm: Optional[CommConfig] = None,
     input_transform: Optional[Callable] = None,
     donate_batch: bool = False,
+    plan=None,
 ):
     """Staleness-s data parallelism (SSP, ssp_consistency_controller.cpp:37-161).
 
@@ -637,13 +685,40 @@ def build_ssp_train_step(
     comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
     axis = comm.axis
     dcn = comm.dcn_axis
+    # Named SPMD mesh (parallel/spmd.py): every (data, fsdp) device keeps
+    # a divergent local copy (flat-mesh SSP semantics over both dp axes);
+    # the boundary's arena delta exchange is resharded over fsdp —
+    # reduce-scatter, psum the shard over data, all-gather back — so the
+    # slow-tier bytes split by the fsdp size. tp does not compose with
+    # SSP local steps (a tp-sharded layer needs its per-step psum).
+    plan_fsdp = 1
+    if plan is not None and plan.active:
+        if plan.mesh_cfg.tp > 1:
+            raise ValueError(
+                "SSP staleness does not compose with tensor parallelism: "
+                "tp layers exchange activations every step, which a "
+                "local-step tier has no slot for; use --mesh dpN,fsdpN")
+        if dcn is not None:
+            raise ValueError("--mesh and --dcn_slices do not compose")
+        if comm.server_logic == "adarevision":
+            raise ValueError(
+                "server_logic='adarevision' consumes per-leaf raw "
+                "gradient sums and does not compose with the fsdp-"
+                "sharded delta exchange")
+        plan_fsdp = plan.mesh_cfg.fsdp
     update_fn = make_update_fn(sp, param_mults(net))
     period = staleness + 1
-    # the tier that carries staleness: slices on a two-tier mesh, devices on
-    # a flat one
-    group_axis = dcn if dcn else axis
-    n_groups = mesh.shape[group_axis]
-    n_ici = mesh.shape[axis] if dcn else 1
+    # the tier that carries staleness: slices on a two-tier mesh, devices
+    # on a flat one, every (data, fsdp) device on a named SPMD mesh
+    if plan_fsdp > 1:
+        group_axes: tuple = ("data", "fsdp")
+        n_groups = plan.n_dp
+        n_ici = 1
+    else:
+        group_axis = dcn if dcn else axis
+        group_axes = (group_axis,)
+        n_groups = mesh.shape[group_axis]
+        n_ici = mesh.shape[axis] if dcn else 1
     n_total = n_groups * max(1, n_ici)
 
     for lname in net.param_defs:
@@ -688,15 +763,22 @@ def build_ssp_train_step(
                     if comm.strategy_for(l) == DENSE]
     arena = None
     if comm.param_arena and dense_layers and not adarev and not dcn:
+        # fsdp-aligned buckets so the boundary reduce-scatter shards evenly
         arena = net.arena_layout(frozenset(dense_layers),
-                                 comm.arena_bucket_mb)
+                                 comm.arena_bucket_mb,
+                                 align=plan_fsdp)
     arena_update = (make_arena_update_fn(sp, param_mults(net), arena)
                     if arena is not None else None)
 
     def device_step(ssp: SSPState, batch, rng):
-        flat_idx = lax.axis_index(axis)
-        if dcn:
-            flat_idx = flat_idx + mesh.shape[axis] * lax.axis_index(dcn)
+        if plan_fsdp > 1:
+            flat_idx = lax.axis_index("data") * plan_fsdp + \
+                lax.axis_index("fsdp")
+        else:
+            flat_idx = lax.axis_index(axis)
+            if dcn:
+                flat_idx = flat_idx + \
+                    mesh.shape[axis] * lax.axis_index(dcn)
         rng = jax.random.fold_in(rng, flat_idx)
         if input_transform is not None:
             batch = input_transform(batch)
@@ -767,12 +849,30 @@ def build_ssp_train_step(
             if arena is not None:
                 # bucketed DENSE delta exchange over the arena: the flat
                 # delta's exact bucket ranges, one psum each — elementwise
-                # identical to the per-leaf psums they replace
+                # identical to the per-leaf psums they replace. On an
+                # fsdp mesh each bucket reduce-scatters over fsdp, psums
+                # the shard over data, and all-gathers back: same sum,
+                # slow-tier payload split by the fsdp size.
                 flat_a = arena.pack(anchor)
                 flat_delta = arena.pack(l) - flat_a
-                summed = [wire_psum(b, (group_axis,), "sum",
-                                    comm.wire_dtype)
-                          for b in arena.split_buckets(flat_delta)]
+                summed = []
+                for bi, b in enumerate(arena.split_buckets(flat_delta)):
+                    if plan_fsdp > 1:
+                        b, casted = ((b.astype(comm.wire_jnp_dtype()), True)
+                                     if comm.wire_dtype else (b, False))
+                        with jax.named_scope(f"delta_rs_bucket{bi}"):
+                            b = lax.psum_scatter(b, "fsdp", tiled=True)
+                        if mesh.shape["data"] > 1:
+                            with jax.named_scope(f"delta_ar_bucket{bi}"):
+                                b = lax.psum(b, "data")
+                        with jax.named_scope(f"delta_ag_bucket{bi}"):
+                            b = lax.all_gather(b, "fsdp", tiled=True)
+                        if casted:
+                            b = b.astype(jnp.float32)
+                        summed.append(b)
+                    else:
+                        summed.append(wire_psum(b, group_axes, "sum",
+                                                comm.wire_dtype))
                 arena_merged = arena.unpack(
                     flat_a + scale * arena.join_buckets(summed))
             for lname, lp in l.items():
@@ -816,7 +916,7 @@ def build_ssp_train_step(
                             block=comm.topk_block, wire=comm.wire_dtype)
                         lerr[pname] = resid
                         delta = sent
-                    m = av + scale * wire_psum(delta, (group_axis,), "sum",
+                    m = av + scale * wire_psum(delta, group_axes, "sum",
                                                comm.wire_dtype)
                     merged[lname][pname] = m
                     new_anchor[lname][pname] = m
@@ -827,7 +927,8 @@ def build_ssp_train_step(
         new_local, new_anchor, new_error, new_server, gsum = lax.cond(
             do_sync, sync, lambda args: args,
             (new_local, ssp.anchor_params, error, ssp.adarev_server, gsum))
-        axes_all = (dcn, axis) if dcn else (axis,)
+        axes_all = (("data", "fsdp") if plan_fsdp > 1
+                    else (dcn, axis) if dcn else (axis,))
         metrics = {"loss": lax.psum(out.loss, axes_all) / n_total}
         for name, val in out.outputs.items():
             if val.ndim == 0:
@@ -838,8 +939,12 @@ def build_ssp_train_step(
                         new_anchor, new_solver.it, unsq(new_error),
                         new_server, unsq(gsum)), metrics
 
-    g = group_axis
-    batch_spec = P((dcn, axis)) if dcn else P(axis)
+    if plan_fsdp > 1:
+        g: object = ("data", "fsdp")
+        batch_spec = P(("data", "fsdp"))
+    else:
+        g = group_axes[0]
+        batch_spec = P((dcn, axis)) if dcn else P(axis)
     ssp_spec = SSPState(P(g), P(g), P(), P(), P(g), P(), P(g))
     sharded = shard_map(
         device_step, mesh=mesh,

@@ -45,7 +45,8 @@ from ..data.varint import read_varint
 
 __all__ = [
     "parse_xspace", "load_trace_events", "hlo_scope_map", "scope_of",
-    "layer_cost_table", "attribute", "format_table", "measure_then_trace",
+    "comm_axis_of", "layer_cost_table", "attribute", "format_table",
+    "measure_then_trace",
 ]
 
 
@@ -241,6 +242,41 @@ def _peel(component: str) -> Optional[str]:
         component = m.group(2)
 
 
+# collective named scopes emitted by the comm machinery (strategies.py
+# arena buckets, spmd.py mesh collectives): each carries its mesh axis in
+# the name, so a profiled step attributes comm time PER AXIS instead of
+# lumping it into the residual row. Matched as whole path components.
+COMM_SCOPE_RE = re.compile(
+    r"^(grad_sync_bucket\d+|grad_rs_bucket\d+|grad_ar_bucket\d+"
+    r"|param_ag_bucket\d+|hist_ag_bucket\d+|delta_rs_bucket\d+"
+    r"|delta_ar_bucket\d+|delta_ag_bucket\d+"
+    r"|tp_fwd_[\w.\-]+|tp_dx_[\w.\-]+"
+    r"|grad_tp_[\w.\-]+|grad_fused_[\w.\-]+)$")
+
+_COMM_AXIS_PREFIX = (
+    ("grad_rs_bucket", "fsdp"), ("param_ag_bucket", "fsdp"),
+    ("hist_ag_bucket", "fsdp"), ("delta_rs_bucket", "fsdp"),
+    ("delta_ag_bucket", "fsdp"), ("grad_ar_bucket", "data"),
+    ("delta_ar_bucket", "data"), ("grad_sync_bucket", "data"),
+    ("tp_fwd_", "tp"), ("tp_dx_", "tp"),
+)
+
+
+def comm_axis_of(scope: str) -> Optional[str]:
+    """Mesh axis a comm scope's collective rides, or None for non-comm
+    scopes. The hierarchical per-leaf psums carry the axis as a suffix
+    (``grad_tp_<layer>_<param>_fsdp`` / ``_data``)."""
+    for prefix, axis in _COMM_AXIS_PREFIX:
+        if scope.startswith(prefix):
+            return axis
+    if scope.startswith(("grad_tp_", "grad_fused_")):
+        if scope.endswith("_fsdp"):
+            return "fsdp"
+        if scope.endswith("_data"):
+            return "data"
+    return None
+
+
 def scope_of(op_name: str, layer_names, extra_scopes=frozenset()):
     """(scope, phase) for one op_name metadata path, or (None, None).
 
@@ -248,7 +284,10 @@ def scope_of(op_name: str, layer_names, extra_scopes=frozenset()):
     peeled path components are matched against each layer's own component
     sequence — longest layer first, contiguous subsequence. Phase is
     'bwd' when the path went through an autodiff transpose, else 'fwd';
-    extra (non-layer) scopes — arena/update phases — report 'misc'."""
+    extra (non-layer) scopes — arena/update phases — report 'misc', and
+    the comm machinery's per-bucket/per-axis collective scopes
+    (``COMM_SCOPE_RE``) are recognized unconditionally so comm time
+    lands in named per-axis rows rather than the residual."""
     comps = [p for p in (_peel(c) for c in op_name.split("/"))
              if p is not None]
     joined = "/".join(comps)
@@ -258,6 +297,9 @@ def scope_of(op_name: str, layer_names, extra_scopes=frozenset()):
             if comps[i:i + len(ln)] == ln:
                 phase = "bwd" if "transpose(" in op_name else "fwd"
                 return lname, phase
+    for c in comps:
+        if COMM_SCOPE_RE.match(c):
+            return c, "misc"
     for extra in extra_scopes:
         if extra in comps or extra in joined:
             return extra, "misc"

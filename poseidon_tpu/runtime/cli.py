@@ -56,6 +56,19 @@ def _engine_from_args(args, phase_nets=True):
         # same config, default strategy reset (auto_strategies fills in SFB)
         comm = dataclasses.replace(comm, default_strategy="dense")
     mesh = None
+    mesh_cfg = None
+    mesh_spec = getattr(args, "mesh", "")
+    if mesh_spec:
+        from ..config import MeshConfig
+        mesh_cfg = MeshConfig.parse(mesh_spec)
+        if getattr(args, "dcn_slices", 0) > 1:
+            raise SystemExit("--mesh and --dcn_slices do not compose: the "
+                             "named mesh's axes carry the whole topology")
+        import jax
+        if mesh_cfg.n_devices > jax.device_count():
+            raise SystemExit(
+                f"--mesh {mesh_spec} needs {mesh_cfg.n_devices} devices; "
+                f"{jax.device_count()} available")
     dcn_slices = getattr(args, "dcn_slices", 0)
     if dcn_slices > 1:
         # two-tier mesh: slices over the slow (DCN) axis, devices within a
@@ -91,7 +104,8 @@ def _engine_from_args(args, phase_nets=True):
                 async_cfg[key] = v
         staleness = 0
     metrics_port = getattr(args, "metrics_port", -1)
-    return Engine(sp, comm=comm, mesh=mesh, output_dir=args.output_dir,
+    return Engine(sp, comm=comm, mesh=mesh, mesh_cfg=mesh_cfg,
+                  output_dir=args.output_dir,
                   staleness=staleness, sfb_auto=args.sfb_auto,
                   steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
                   device_transform=getattr(args, "device_transform", False),
@@ -689,6 +703,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "native) + the exact space-to-depth stem rewrite; "
                         "params/updates stay f32. Default f32 matches "
                         "Caffe numerics exactly (direct conv1 formulation)")
+    t.add_argument("--mesh", default="",
+                   help="named SPMD mesh spec, e.g. 'dp2,fsdp2,tp1' "
+                        "(axes: dp = data parallel, fsdp = sharded "
+                        "parameter arena with reduce-scatter/all-gather "
+                        "buckets, tp = tensor-parallel FC column/row "
+                        "shards planned per layer); sizes of 1 "
+                        "deactivate an axis. Empty = the flat data mesh")
     t.add_argument("--dcn_slices", type=int, default=0,
                    help="split devices into N slices on a slow (DCN) mesh "
                         "axis: dense sync intra-slice, TOPK-compressed "

@@ -37,7 +37,8 @@ import os
 import pickle
 from typing import Any, Dict, Optional
 
-__all__ = ["enable_compile_cache", "cache_entries", "step_key",
+__all__ = ["enable_compile_cache", "disable_compile_cache",
+           "cache_entries", "step_key",
            "save_step_executable", "load_step_executable", "aot_entries"]
 
 
@@ -73,6 +74,31 @@ def enable_compile_cache(cache_dir: str,
     except Exception:  # noqa: BLE001 — fresh process: nothing to reset
         pass
     return cache_dir
+
+
+def disable_compile_cache() -> None:
+    """Turn the persistent cache back OFF for this process.
+
+    The cache config is process-global: a test (or embedder) that enabled
+    it against a temporary directory and walks away leaves EVERY later
+    compile in the process serializing/deserializing through that path —
+    and once the directory is garbage-collected out from under jax
+    (pytest keeps only the last few tmp_path dirs), later cache reads
+    deserialize torn entries and take the whole process down with a
+    SIGSEGV/abort deep inside jax. This was the long-standing flaky
+    tier-1 crash: the PR-6 compile-cache tests enabled the cache at a
+    tmp_path and never disabled it. Pair every test-scoped
+    ``enable_compile_cache`` with a ``finally: disable_compile_cache()``.
+    """
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — nothing initialized: nothing to do
+        pass
 
 
 def cache_entries(cache_dir: str) -> int:

@@ -93,6 +93,7 @@ class Engine:
         sp: SolverParameter,
         comm: Optional[CommConfig] = None,
         mesh=None,
+        mesh_cfg=None,
         memory_data: Optional[Dict[str, np.ndarray]] = None,
         output_dir: str = ".",
         staleness: int = 0,
@@ -120,9 +121,24 @@ class Engine:
         self.async_snapshot = bool(_pc.async_snapshot
                                    if async_snapshot is None
                                    else async_snapshot)
+        # named SPMD mesh (--mesh dp2,fsdp2,tp1 -> config.MeshConfig):
+        # the sharding planner (parallel/spmd.py) computes the per-layer
+        # plan below, once the train net exists
+        self.mesh_cfg = mesh_cfg
+        self.plan = None
+        if mesh_cfg is not None:
+            # honored even when inactive (fsdp=tp=1): '--mesh dp2' means
+            # TWO devices, not a silent fall-through to all of them
+            if mesh is not None:
+                raise ValueError("pass mesh or mesh_cfg, not both")
+            from ..parallel.spmd import named_mesh
+            mesh = named_mesh(mesh_cfg)
         self.mesh = mesh or make_mesh()
         self.n_dev = int(np.prod(list(self.mesh.shape.values())))
         self.comm = comm or CommConfig()
+        if self.plan is None and mesh_cfg is not None and mesh_cfg.active \
+                and self.comm.dcn_axis is not None:
+            raise ValueError("--mesh and --dcn_slices do not compose")
         self.staleness = staleness
         self.output_dir = output_dir
         self.stats = StatsRegistry()
@@ -222,6 +238,21 @@ class Engine:
         self.train_pipelines, train_shapes = self._build_pipelines(
             train_param, "TRAIN")
         self.train_net = Net(train_param, "TRAIN", source_shapes=train_shapes)
+        if self.mesh_cfg is not None and self.mesh_cfg.active:
+            from ..parallel.spmd import ShardingPlan
+            self.plan = ShardingPlan.build(
+                self.train_net, self.mesh_cfg, self.comm,
+                shard_params=self.mesh_cfg.shard,
+                enable_tp=self.mesh_cfg.shard)
+            log(f"sharding plan: {self.plan.describe()}", rank=self.rank)
+            if self.iter_size > 1:
+                log("WARNING: iter_size > 1 does not compose with --mesh "
+                    "sharding yet; running iter_size=1", rank=self.rank)
+                self.iter_size = 1
+            if max(1, int(steps_per_dispatch)) > 1:
+                log("WARNING: steps_per_dispatch ignored under --mesh "
+                    "sharding", rank=self.rank)
+                steps_per_dispatch = 1
         self._input_transform = self._make_input_transform()
         if self._device_transform and self._input_transform is None:
             log("WARNING: --device_transform requested but no train data "
@@ -298,7 +329,8 @@ class Engine:
             ssp_ts = build_ssp_train_step(self.train_net, sp, self.mesh,
                                           staleness, self.comm,
                                           input_transform=self._input_transform,
-                                          donate_batch=donate_batch)
+                                          donate_batch=donate_batch,
+                                          plan=self.plan)
             raw_step = ssp_ts.step
 
             def _ssp_step(params, state, batch, rng):
@@ -320,10 +352,16 @@ class Engine:
                     "the TRAIN net (per-iteration dump semantics)",
                     rank=self.rank)
                 self.iter_size = 1
+            if dump and self.plan is not None:
+                log("WARNING: HDF5_OUTPUT in the TRAIN net is not dumped "
+                    "under --mesh sharding", rank=self.rank)
+                dump = []
+                self._h5_train = []
             self.train_step = build_train_step(
                 self.train_net, sp, self.mesh, self.comm, dump_blobs=dump,
                 input_transform=self._input_transform,
-                iter_size=self.iter_size, donate_batch=donate_batch)
+                iter_size=self.iter_size, donate_batch=donate_batch,
+                plan=self.plan)
 
         # --- multi-step dispatch (scan chunks) ---------------------------- #
         # K optimizer steps per compiled dispatch: amortizes the runtime's
@@ -350,7 +388,8 @@ class Engine:
                     input_transform=self._input_transform,
                     iter_size=self.iter_size)
         self.eval_steps = [
-            build_eval_step(n, self.mesh, dcn_axis=self.comm.dcn_axis)
+            build_eval_step(n, self.mesh, dcn_axis=self.comm.dcn_axis,
+                            plan=self.plan)
             for n in self.test_nets]
 
         # --- state -------------------------------------------------------- #
@@ -397,9 +436,14 @@ class Engine:
         _ccc = compile_cache_config()
         self._aot_exec = None
         self._aot_failed = False
+        # the AOT step store calls lowerable.lower(params, state, batch,
+        # rng) and replays the executable with those four args; the spmd
+        # step carries bound trailing (sharded multiplier) arguments the
+        # replay would miss, so warm start stands down under a plan
         self._aot_enabled = (bool(_ccc.cache_dir) and _ccc.aot_steps
                              and staleness == 0 and not self._h5_train
-                             and self.iter_size == 1)
+                             and self.iter_size == 1
+                             and self.plan is None)
 
         self._h5_outputs = [
             [(l.lp.hdf5_output_param.file_name, list(l.lp.bottom))

@@ -169,6 +169,32 @@ def count_gradient_all_reduces(hlo_text: str,
 _STABLEHLO_AR_RE = re.compile(
     r'"stablehlo\.all_reduce".*?\)\s*->\s*tensor<([0-9x]*)f32>', re.S)
 
+# every collective kind the SPMD planner schedules, with its result type
+# (all_reduce's region makes the result sit after the region's `->`; the
+# others are plain one-line ops). bf16/f16 wires count too.
+_STABLEHLO_COLL_RE = re.compile(
+    r'"stablehlo\.(all_reduce|reduce_scatter|all_gather)"'
+    r'.*?->\s*tensor<([0-9x]*)(f32|bf16|f16)>', re.S)
+
+
+def collective_census_stablehlo(text: str,
+                                min_elements: int = 256) -> Dict[str, int]:
+    """Counts of all_reduce / reduce_scatter / all_gather ops in a LOWERED
+    (pre-XLA) program whose payload is at least ``min_elements`` elements
+    — the cheap, combiner-proof census the SPMD planner's
+    ``collective_schedule`` is diffed against (analysis/contracts.py).
+    Lowered counts are exact for the planned schedule: the arena's
+    chained buckets cannot legally merge, and XLA only ever merges,
+    never splits."""
+    out = {"all_reduce": 0, "reduce_scatter": 0, "all_gather": 0}
+    for m in _STABLEHLO_COLL_RE.finditer(text):
+        dims = m.group(2).rstrip("x")
+        elems = int(np.prod([int(d) for d in dims.split("x")])) \
+            if dims else 1
+        if elems >= min_elements:
+            out[m.group(1)] += 1
+    return out
+
 
 def count_gradient_all_reduces_stablehlo(text: str,
                                          min_elements: int = 256) -> int:

@@ -119,33 +119,24 @@ def make_update_fn(sp: SolverParameter, mults: Dict[str, Dict[str, tuple]]):
     return update
 
 
-def make_fused_update_fn(sp: SolverParameter, layout):
-    """One fused elementwise pass over the flat arena buffer — the same
-    SGD/Nesterov/AdaGrad rule as ``_leafwise_update``, with the per-leaf
-    lr_mult / decay_mult scalars expanded into the layout's precomputed
-    arena-resident multiplier segments. Bit-identical to the per-leaf loop:
-    every scalar is rounded to f32 exactly where the per-leaf path rounds
-    it (see ArenaLayout.mult_vectors), the zero-decay skip becomes an
-    elementwise select of the untouched gradient, and the operation order
-    is unchanged.
-
-    Returns fused(flat_w, flat_g, flat_h, rate) -> (flat_w', flat_h').
-    The SGD+momentum+L2 shape (the Caffe default) can additionally route
-    through the Pallas kernel variant (ops/pallas_kernels.fused_sgd) —
-    opt-in via POSEIDON_PALLAS_UPDATE=1, same math, one VMEM pass."""
+def make_flat_update_rule(sp: SolverParameter):
+    """The fused flat update rule with the multiplier vectors as ARGUMENTS:
+    fused(flat_w, flat_g, flat_h, rate, lr_vec, decay_vec) ->
+    (flat_w', flat_h'). ``make_fused_update_fn`` binds the arena layout's
+    precomputed full-buffer vectors; the SPMD sharded step
+    (parallel/spmd.py) instead feeds each device its fsdp SHARD of the
+    vectors, so the update touches 1/fsdp of the buffer per device with
+    identical elementwise math."""
     solver_type = sp.solver_type
     momentum = sp.momentum
     reg_type = sp.regularization_type
     delta = sp.delta
-    lr_np, decay_np = layout.mult_vectors(sp.weight_decay)
     if solver_type not in ("SGD", "NESTEROV", "ADAGRAD"):
         raise ValueError(f"unknown solver_type {solver_type!r}")
     if reg_type not in ("L2", "L1"):
         raise ValueError(f"unknown regularization_type {reg_type!r}")
 
-    def fused(flat_w, flat_g, flat_h, rate):
-        lr_vec = jnp.asarray(lr_np)
-        decay_vec = jnp.asarray(decay_np)
+    def fused(flat_w, flat_g, flat_h, rate, lr_vec, decay_vec):
         local_rate = rate * lr_vec
         g = flat_g.astype(jnp.float32)
         if solver_type == "SGD" and reg_type == "L2":
@@ -168,6 +159,30 @@ def make_fused_update_fn(sp: SolverParameter, layout):
             h_new = flat_h + g * g
             step = local_rate * g / (jnp.sqrt(h_new) + delta)
         return (flat_w - step).astype(flat_w.dtype), h_new
+
+    return fused
+
+
+def make_fused_update_fn(sp: SolverParameter, layout):
+    """One fused elementwise pass over the flat arena buffer — the same
+    SGD/Nesterov/AdaGrad rule as ``_leafwise_update``, with the per-leaf
+    lr_mult / decay_mult scalars expanded into the layout's precomputed
+    arena-resident multiplier segments. Bit-identical to the per-leaf loop:
+    every scalar is rounded to f32 exactly where the per-leaf path rounds
+    it (see ArenaLayout.mult_vectors), the zero-decay skip becomes an
+    elementwise select of the untouched gradient, and the operation order
+    is unchanged.
+
+    Returns fused(flat_w, flat_g, flat_h, rate) -> (flat_w', flat_h').
+    The SGD+momentum+L2 shape (the Caffe default) can additionally route
+    through the Pallas kernel variant (ops/pallas_kernels.fused_sgd) —
+    opt-in via POSEIDON_PALLAS_UPDATE=1, same math, one VMEM pass."""
+    rule = make_flat_update_rule(sp)
+    lr_np, decay_np = layout.mult_vectors(sp.weight_decay)
+
+    def fused(flat_w, flat_g, flat_h, rate):
+        return rule(flat_w, flat_g, flat_h, rate, jnp.asarray(lr_np),
+                    jnp.asarray(decay_np))
 
     return fused
 

@@ -706,6 +706,137 @@ def section_lm_long_context(topo) -> dict:
 
 
 # ------------------------------------------------------------------------- #
+# 5c. SPMD mesh: sharded-arena memory + collective schedule on the TPU target
+# ------------------------------------------------------------------------- #
+
+def section_mesh(topo) -> dict:
+    """ROADMAP item 1's off-tunnel evidence: AOT-compile (a) the AlexNet
+    dp2 x fsdp2 SHARDED-STATE step (params + momentum live 1/fsdp per
+    device) and its replicated control for abstract v5e, recording each
+    arm's collective census and the compiler's per-device HBM estimate —
+    the sharded memory win on record before real-TPU re-measurement —
+    and (b) the GPT-small dp2 x tp4 step's census + HBM estimate (its
+    comm bill is already in lm_gpt_small.json; this adds the memory
+    half)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from poseidon_tpu.config import MeshConfig
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import CommConfig, init_train_state
+    from poseidon_tpu.parallel.mesh import SPMD_AXES
+    from poseidon_tpu.parallel.spmd import (ShardingPlan,
+                                            build_spmd_train_step,
+                                            sharded_state_avals)
+    from poseidon_tpu.proto.messages import SolverParameter
+    from poseidon_tpu.runtime.hlo_comm import (collective_census_stablehlo,
+                                               measured_comm_summary,
+                                               parse_collectives)
+
+    def mem(compiled) -> dict:
+        ma = compiled.memory_analysis()
+        return {k: int(getattr(ma, k, 0)) for k in
+                ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes", "alias_size_in_bytes")}
+
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0005)
+    comm = CommConfig()
+    out = {}
+
+    # ---- AlexNet dp2 x fsdp2: sharded-state vs replicated ------------- #
+    mcfg = MeshConfig(data=2, fsdp=2, tp=1)
+    mesh = Mesh(np.array(topo.devices[:4]).reshape(2, 2, 1), SPMD_AXES)
+    image, per_dev = 227, 16
+    net = Net(zoo.alexnet(num_classes=1000, with_accuracy=False),
+              phase="TRAIN",
+              source_shapes={"data": (per_dev, 3, image, image),
+                             "label": (per_dev,)})
+    gbatch = per_dev * 4
+    batch_avals = {
+        "data": jax.ShapeDtypeStruct(
+            (gbatch, 3, image, image), jnp.float32,
+            sharding=NamedSharding(mesh, P(("data", "fsdp")))),
+        "label": jax.ShapeDtypeStruct(
+            (gbatch,), jnp.int32,
+            sharding=NamedSharding(mesh, P(("data", "fsdp"))))}
+    rng_aval = jax.ShapeDtypeStruct(
+        (2,), jnp.uint32, sharding=NamedSharding(mesh, P()))
+    for arm, shard_params, sharded_state in (
+            ("replicated", False, False), ("fsdp2_sharded", True, True)):
+        t0 = time.time()
+        plan = ShardingPlan.build(net, mcfg, comm,
+                                  shard_params=shard_params)
+        ts = build_spmd_train_step(net, sp, mesh, plan, comm,
+                                   donate=False,
+                                   sharded_state=sharded_state)
+        if sharded_state:
+            st = sharded_state_avals(net, ts.arena, plan, mesh)
+            lowered = ts.lowerable.lower(st, batch_avals, rng_aval)
+        else:
+            params = net.init(jax.random.PRNGKey(0))
+            state = init_train_state(params, comm, 4)
+            lowered = ts.lowerable.lower(params, state, batch_avals,
+                                         rng_aval)
+        census = collective_census_stablehlo(lowered.as_text())
+        compiled = lowered.compile()
+        txt = compiled.as_text()
+        out[f"alexnet_{arm}"] = {
+            "mesh": mcfg.describe(), "sharded_state": sharded_state,
+            "global_batch": gbatch, "image": image,
+            "lowered_census": census,
+            "planned_counts": plan.collective_schedule(
+                ts.arena, net, comm=comm,
+                sharded_state=sharded_state)["counts"],
+            "comm_bytes": measured_comm_summary(parse_collectives(txt)),
+            "hbm": mem(compiled),
+            "compile_seconds": round(time.time() - t0, 1)}
+        print(f"[aot]   mesh/alexnet_{arm}: census {census}, "
+              f"hbm {out[f'alexnet_{arm}']['hbm']}", flush=True)
+    rep = out["alexnet_replicated"]["hbm"]
+    sh = out["alexnet_fsdp2_sharded"]["hbm"]
+    if rep.get("argument_size_in_bytes"):
+        # the acceptance ratio: persistent (argument) bytes per device —
+        # params + momentum dominate; ~1/fsdp of replicated expected
+        out["alexnet_argument_bytes_ratio"] = round(
+            sh["argument_size_in_bytes"] / rep["argument_size_in_bytes"],
+            4)
+
+    # ---- GPT-small dp2 x tp4: census + HBM estimate ------------------- #
+    from poseidon_tpu import config as pconfig
+    from poseidon_tpu.models.transformer import (build_dp_tp_train_step,
+                                                 gpt_small_config,
+                                                 init_params, to_tp_layout)
+    from poseidon_tpu.solvers.updates import init_state
+    rs = np.random.RandomState(0)
+    mesh8 = _mesh(topo, ("data", "model"), (2, 4))
+    seq, gbatch = 1024, 16
+    cfg = gpt_small_config(max_seq=seq)
+    t0 = time.time()
+    with pconfig.policy_scope(compute_dtype=jnp.bfloat16):
+        lp = to_tp_layout(init_params(cfg, jax.random.PRNGKey(0)), cfg)
+        step = build_dp_tp_train_step(cfg, sp, mesh8, lp, donate=False)
+        ls = init_state(lp)
+        toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (gbatch, seq),
+                                      dtype=np.int32))
+        lowered = step.lower(lp, ls, toks, toks, jax.random.PRNGKey(1))
+        compiled = lowered.compile()
+    out["lm_gpt_small_dp2_tp4"] = {
+        "seq": seq, "global_batch": gbatch,
+        "lowered_census": collective_census_stablehlo(lowered.as_text()),
+        "comm_bytes": measured_comm_summary(
+            parse_collectives(compiled.as_text())),
+        "hbm": mem(compiled),
+        "compile_seconds": round(time.time() - t0, 1)}
+    print(f"[aot]   mesh/lm_gpt_small_dp2_tp4: "
+          f"{out['lm_gpt_small_dp2_tp4']['comm_bytes']}", flush=True)
+    return out
+
+
+# ------------------------------------------------------------------------- #
 # 6. Headline-config search: layout x stem rewrite, ranked by the cost model
 # ------------------------------------------------------------------------- #
 
@@ -778,6 +909,7 @@ SECTIONS = {
     "layer_cycles": section_layer_cycles,
     "lm_gpt_small": section_lm_gpt_small,
     "lm_long_context": section_lm_long_context,
+    "mesh": section_mesh,
     "cnn_configs": section_cnn_configs,
 }
 

@@ -679,16 +679,23 @@ def test_compile_cache_enable_and_entries(tmp_path):
     import jax.numpy as jnp
 
     from poseidon_tpu.runtime.compile_cache import (cache_entries,
+                                                    disable_compile_cache,
                                                     enable_compile_cache)
 
     cache = enable_compile_cache(str(tmp_path / "cc"))
-    assert jax.config.jax_compilation_cache_dir == cache
-    before = cache_entries(cache)
-    x = jnp.ones((16, 16))
-    jax.block_until_ready(
-        jax.jit(lambda a: jnp.tanh(a) @ a.T, donate_argnums=())(x))
-    assert cache_entries(cache) > before, \
-        "the persistent cache recorded no entry for a fresh compile"
+    try:
+        assert jax.config.jax_compilation_cache_dir == cache
+        before = cache_entries(cache)
+        x = jnp.ones((16, 16))
+        jax.block_until_ready(
+            jax.jit(lambda a: jnp.tanh(a) @ a.T, donate_argnums=())(x))
+        assert cache_entries(cache) > before, \
+            "the persistent cache recorded no entry for a fresh compile"
+    finally:
+        # the cache config is process-global and tmp_path gets garbage-
+        # collected: leaving it enabled made LATER tests' compiles
+        # deserialize torn entries and abort the whole tier-1 run
+        disable_compile_cache()
 
 
 def test_step_key_stability_and_sensitivity():
@@ -762,6 +769,7 @@ def test_engine_aot_warm_start_loads_across_engines(tmp_path):
     skipped) and trains to bit-identical final params."""
     from poseidon_tpu import config
     from poseidon_tpu.runtime.compile_cache import (aot_entries,
+                                                    disable_compile_cache,
                                                     enable_compile_cache)
 
     cache = enable_compile_cache(str(tmp_path / "cc"))
@@ -781,3 +789,4 @@ def test_engine_aot_warm_start_loads_across_engines(tmp_path):
         assert last1["loss"] == last2["loss"]
     finally:
         config.set_compile_cache_config(cache_dir="", aot_steps=True)
+        disable_compile_cache()
