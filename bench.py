@@ -1254,6 +1254,12 @@ def _attr_one(model: str, per_dev_batch: int, iters: int, classes: int,
         holder["params"], holder["state"], m = out[:3]
         jax.block_until_ready(m["loss"])
 
+    conv_plan = {k: v for k, v in net.conv_strategy_plan().items() if v}
+    if conv_plan:
+        print(f"[bench] {model} conv strategies: "
+              + ", ".join(f"{k}={v}" for k, v in conv_plan.items()),
+              file=sys.stderr, flush=True)
+
     trace_dir = trace_keep or tempfile.mkdtemp(prefix=f"attr_{model}_")
     try:
         timing = A.measure_then_trace(run_step, trace_dir, iters=iters)
@@ -1290,6 +1296,7 @@ def _attr_one(model: str, per_dev_batch: int, iters: int, classes: int,
                 comm_by_axis.get(ax, 0.0) + r["total_ms"], 4)
     doc = {
         "comm_ms_by_axis": comm_by_axis,
+        "conv_strategy_plan": conv_plan,
         "model": model,
         "per_device_batch": per_dev_batch,
         "step_ms_timed": timing["step_ms"],
@@ -1356,6 +1363,17 @@ def attribution_main(argv: list) -> None:
 
     from poseidon_tpu import config
     config.set_perf_policy()
+    # per-layer measured conv strategy rides the attribution run by
+    # default: the choices print with their micro-run times, and the
+    # winner documents persist (evidence/conv_tune unless a compile-cache
+    # dir is already configured) so a second run skips re-measurement.
+    # POSEIDON_BENCH_CONV_STRATEGY: ''=legacy, or direct/im2col/s2d.
+    conv_strategy = os.environ.get("POSEIDON_BENCH_CONV_STRATEGY", "auto")
+    if conv_strategy:
+        config.set_policy(conv_strategy=conv_strategy)
+        if not config.compile_cache_config().cache_dir:
+            config.set_compile_cache_config(
+                cache_dir=os.path.join(_REPO, "evidence", "conv_tune"))
     kind = jax.devices()[0].device_kind
     peak = PEAK_FLOPS.get(kind, DEFAULT_PEAK) if on_accel else None
     models = ATTR_MODELS if args.model == "all" else (args.model,)
@@ -1393,6 +1411,30 @@ def attribution_main(argv: list) -> None:
     except OSError as e:
         print(f"[bench] attribution out write failed: {e}", file=sys.stderr,
               flush=True)
+
+    # the sink ranking as its own BENCH line, so "which named row eats the
+    # step" is tracked across rounds in the BENCH stream, not just in
+    # evidence JSON: top-3 named rows by self time + their share of traced
+    # op time, per model; headline value = the top-3 combined share on the
+    # largest model measured (the lift target — it FALLS as kernels land)
+    sinks = {}
+    for m, d in docs.items():
+        tot = d["total_ms"] or 1.0
+        sinks[m] = [{"row": r["layer"], "self_ms": r["total_ms"],
+                     "share": round(r["total_ms"] / tot, 4)}
+                    for r in d["rows"][:3]]
+    head = next((m for m in ("googlenet", "alexnet") if m in docs),
+                next(iter(docs)))
+    emit({
+        "metric": "top_self_time_sinks",
+        "value": round(sum(s["share"] for s in sinks[head]), 4),
+        "unit": "fraction_of_traced_self_time",
+        "vs_baseline": 1.0,
+        "model": head,
+        "backend": jax.default_backend(),
+        "cpu_proxy": not on_accel,
+        "sinks": sinks,
+    })
 
     coverage = min(d["coverage"] for d in docs.values())
     emit({

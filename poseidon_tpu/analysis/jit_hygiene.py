@@ -32,6 +32,15 @@ properties are lexical — so they are lintable:
   silently reclassifies that phase's device time into the
   ``(unattributed)`` residual row of the per-layer table.
 
+**Pallas kernel bodies** (functions passed — directly or through
+``functools.partial`` — as the first argument of a ``pl.pallas_call``) are
+traced too, so JIT101 covers them, with one carve-out: the ``np.*``
+patterns are NOT flagged there. Inside a Mosaic kernel every value is a
+Ref or a trace-time constant — ``np.asarray`` on static index math cannot
+be a device sync because there is no device value to sync — while
+``.item()`` / ``.block_until_ready()`` / ``jax.device_get`` remain real
+defects (they cannot lower at all) and still fire.
+
 Pure ``ast``; jax-free at import.
 """
 
@@ -93,7 +102,9 @@ def _alias_map(tree: ast.Module) -> Dict[str, str]:
                     if a.asname:
                         out[a.asname] = (
                             "np" if root == "numpy" else
-                            ("jnp" if a.name == "jax.numpy" else "jax"))
+                            ("jnp" if a.name == "jax.numpy" else
+                             ("pallas" if a.name.startswith(
+                                 "jax.experimental.pallas") else "jax")))
                     else:
                         # `import jax.numpy` binds only the ROOT name —
                         # mapping 'jax' to jnp would blind the
@@ -104,6 +115,17 @@ def _alias_map(tree: ast.Module) -> Dict[str, str]:
             if root == "jax" and n.module == "jax.numpy":
                 for a in n.names:
                     out.setdefault(a.asname or a.name, "jnp_member")
+            elif n.module.startswith("jax.experimental"):
+                for a in n.names:
+                    if a.name == "pallas":     # from jax.experimental ...
+                        out[a.asname or a.name] = "pallas"
+                    elif a.name == "pallas_call":
+                        out[a.asname or a.name] = "pallas_member"
+                    elif a.name in TRACING_WRAPPERS:
+                        # from jax.experimental.shard_map import shard_map:
+                        # still a tracing wrapper — this branch must not
+                        # shadow the plain-jax mapping below
+                        out[a.asname or a.name] = "jax_member"
             elif root == "jax":
                 for a in n.names:
                     if a.name in TRACING_WRAPPERS:
@@ -296,6 +318,35 @@ def _traced_functions(tree: ast.Module, aliases: Dict[str, str],
     return traced
 
 
+def _pallas_kernel_bodies(tree: ast.Module, aliases: Dict[str, str],
+                          index: Dict[str, ast.AST]) -> Set[str]:
+    """Qualnames of functions handed to ``pl.pallas_call`` as the kernel —
+    directly, or wrapped in ``functools.partial(kernel, ...)`` (the
+    repo's static-parameter idiom)."""
+    bodies: Set[str] = set()
+
+    def is_pallas_call(func) -> bool:
+        if isinstance(func, ast.Attribute) and func.attr == "pallas_call":
+            return aliases.get(_root_of(func) or "") == "pallas"
+        return isinstance(func, ast.Name) and \
+            aliases.get(func.id) == "pallas_member"
+
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call) and is_pallas_call(n.func)
+                and n.args):
+            continue
+        k = n.args[0]
+        if isinstance(k, ast.Call):            # functools.partial(kernel, …)
+            f = k.func
+            is_partial = (isinstance(f, ast.Name) and f.id == "partial") \
+                or (isinstance(f, ast.Attribute) and f.attr == "partial")
+            if is_partial and k.args:
+                k = k.args[0]
+        if isinstance(k, ast.Name) and k.id in index:
+            bodies.add(k.id)
+    return bodies
+
+
 def _named_scope_strings(tree: ast.Module) -> Tuple[Set[str], bool]:
     """(literal/prefix scope names, saw a dynamic-arg named_scope call)."""
     names: Set[str] = set()
@@ -337,17 +388,29 @@ def lint_file(path: str, source: Optional[str] = None,
     findings: List[Finding] = []
 
     # ---- JIT101: host sync inside traced functions -------------------- #
-    for q in sorted(_traced_functions(tree, aliases, index)):
+    pallas_bodies = _pallas_kernel_bodies(tree, aliases, index)
+    for q in sorted(_traced_functions(tree, aliases, index)
+                    | pallas_bodies):
         node = index[q]
         if _fn_pragma(lines, node, "JIT101"):
             continue
         body = ast.Module(body=list(node.body), type_ignores=[])
         sf = _SyncFinder(aliases, descend=False)
         sf.visit(body)
+        in_kernel = q in pallas_bodies
         for line, what in sf.hits:
+            if in_kernel and (what.startswith("np.")
+                              or aliases.get(what) == "np_member"):
+                # Mosaic kernel body: np.* on static index math is
+                # trace-time constant folding, not a host sync — there is
+                # no device value inside the kernel to sync on. The
+                # method/jax syncs below stay flagged (they cannot lower).
+                continue
+            where = ("Pallas kernel body" if in_kernel
+                     else "traced function")
             findings.append(Finding(
                 rule="JIT101", path=rel, line=line, symbol=q, key=what,
-                message=f"{what} inside traced function {q!r}: a host "
+                message=f"{what} inside {where} {q!r}: a host "
                         f"sync here either fails at trace time or "
                         f"constant-folds a device value into the "
                         f"compiled program"))

@@ -155,6 +155,10 @@ class ConvolutionLayer(Layer):
         # fused epilogue: set by the net-level plan when an in-place ReLU
         # immediately consumes this conv's top (one XLA kernel per conv)
         self.fused_relu_slope: Optional[float] = None
+        # per-layer lowering strategy: resolved by the net-level plan
+        # (measured under conv_strategy="auto"); None = the legacy global
+        # conv_s2d policy decides inside ops/nn.conv2d
+        self.conv_strategy: Optional[str] = None
 
     def setup(self, bottom_shapes):
         cp = self.lp.convolution_param
@@ -190,7 +194,8 @@ class ConvolutionLayer(Layer):
         act = "relu" if self.fused_relu_slope is not None else None
         return [NN.conv2d(x, w, b, self.stride, self.pad, self.group,
                           layout=self.run_layout, act=act,
-                          act_slope=self.fused_relu_slope or 0.0)
+                          act_slope=self.fused_relu_slope or 0.0,
+                          strategy=self.conv_strategy)
                 for x in bottoms]
 
 

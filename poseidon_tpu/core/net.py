@@ -86,6 +86,7 @@ class Net:
         stages: Sequence[str] = (),
         conv_layout: Optional[str] = None,
         fuse_conv_epilogues: bool = True,
+        conv_strategy: Optional[str] = None,
     ):
         self.net_param = net_param
         self.phase = phase
@@ -103,6 +104,18 @@ class Net:
         if self.conv_layout not in NN.LAYOUTS:
             raise ValueError(f"unknown conv_layout {self.conv_layout!r}")
         self.fuse_conv_epilogues = fuse_conv_epilogues
+        # Conv lowering strategy, also a graph-level request resolved at
+        # construction — but to a PER-LAYER choice: "auto" measures each
+        # conv layer's candidates (direct/im2col/s2d) with short
+        # micro-runs and persists the winner (ops/conv_tune.py); a
+        # concrete value forces one strategy net-wide; "" keeps the
+        # legacy global conv_s2d policy.
+        self.conv_strategy = (conv_strategy if conv_strategy is not None
+                              else policy().conv_strategy) or ""
+        if self.conv_strategy not in NN.CONV_STRATEGIES:
+            raise ValueError(
+                f"unknown conv_strategy {self.conv_strategy!r}; choose "
+                f"from {NN.CONV_STRATEGIES}")
 
         selected = filter_net(net_param, self.state)
         self.source_layer_params: List[LayerParameter] = []
@@ -234,6 +247,7 @@ class Net:
         if self.fuse_conv_epilogues:
             self._plan_epilogues()
         self._plan_layouts()
+        self._plan_conv_strategies()
 
     # ------------------------------------------------------------------ #
     def arena_layout(self, include=None, bucket_mb: float = 4.0,
@@ -309,6 +323,37 @@ class Net:
             for t in layer.lp.top:
                 if len(self.blob_shapes[t]) == 4:
                     cur[t] = run
+
+    def _plan_conv_strategies(self) -> None:
+        """Resolve each conv layer's lowering strategy. "" leaves the
+        legacy global-policy behavior (layer.conv_strategy stays None); a
+        concrete strategy is assigned net-wide; "auto" resolves a MEASURED
+        winner per layer through ops/conv_tune.py — keyed purely by
+        geometry, so GoogLeNet's shape-identical inception branches
+        measure once, and persisted through the compile-cache tuned store
+        so the next process with this job config skips the micro-runs."""
+        req = self.conv_strategy
+        convs = [l for l in self.layers if l.TYPE == "CONVOLUTION"]
+        if not req or not convs:
+            return
+        if req != "auto":
+            for layer in convs:
+                layer.conv_strategy = req
+            return
+        from ..ops import conv_tune
+        from ..runtime.metrics import log
+        for layer in convs:
+            n, c, h, w = self.blob_shapes[layer.lp.bottom[0]]
+            doc = conv_tune.resolve(
+                layer.name, c, h, w, layer.kernel, layer.stride, layer.pad,
+                layer.group, layer.params[0].shape[0], layer.run_layout, n)
+            layer.conv_strategy = doc["winner"]
+            log(f"[conv_strategy] {conv_tune.describe(doc)}")
+
+    def conv_strategy_plan(self) -> Dict[str, Optional[str]]:
+        """{conv layer name: resolved strategy} — what bench/tests print."""
+        return {l.name: l.conv_strategy for l in self.layers
+                if l.TYPE == "CONVOLUTION"}
 
     def _layer_params(self, params, layer: Layer,
                       comm=None) -> Dict[str, jax.Array]:

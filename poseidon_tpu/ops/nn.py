@@ -3,10 +3,14 @@
 These replace the reference's CUDA kernels (``src/caffe/layers/*.cu``,
 ``src/caffe/util/im2col.cu``) with XLA-native formulations: convolution and
 inner product lower directly onto the MXU via ``lax.conv_general_dilated`` /
-``lax.dot_general`` (no explicit im2col on the compute path), pooling via
-``lax.reduce_window`` with Caffe's exact output-size and window-clipping rules,
-and LRN as a fused elementwise + windowed-sum expression XLA folds into
-neighboring ops.
+``lax.dot_general`` (explicit im2col + GEMM is one selectable per-layer
+``strategy``, not the only path), pooling via ``lax.reduce_window`` with
+Caffe's exact output-size and window-clipping rules, and LRN as a fused
+elementwise + windowed-sum expression XLA folds into neighboring ops.
+Pooling and LRN carry custom VJPs: their backwards route to dedicated
+Pallas kernels on TPU and to vectorized/analytic XLA formulations
+elsewhere (the select-and-scatter / autodiff arms stay available for A/B)
+— see "pooling backward strategies" below and ops/pallas_kernels.py.
 
 Layout contract (round 6): every spatial op takes an explicit ``layout``
 ("NCHW" | "NHWC") describing the PHYSICAL layout of its activation inputs
@@ -33,6 +37,7 @@ Numerical semantics follow the reference:
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Tuple
 
@@ -136,10 +141,62 @@ def _space_to_depth_rewrite(x, w, stride, pad, layout: str):
     return x2, w2
 
 
-def _s2d_applicable(x, w, stride, group, layout: str) -> bool:
-    return (policy().conv_s2d and group == 1 and
+def _s2d_shape_ok(x, w, stride, group, layout: str) -> bool:
+    """Structural applicability of the space-to-depth rewrite (few-channel
+    strided conv with a kernel at least as tall as the stride)."""
+    return (group == 1 and
             stride[0] == stride[1] and stride[0] >= 2 and
             x.shape[channel_axis(layout)] <= 4 and w.shape[2] >= stride[0])
+
+
+def _s2d_applicable(x, w, stride, group, layout: str) -> bool:
+    return policy().conv_s2d and _s2d_shape_ok(x, w, stride, group, layout)
+
+
+# the per-layer lowering-strategy axis (Caffe con Troll's measured-choice
+# regime): "" = legacy (the global conv_s2d policy decides), "auto" is
+# resolved to a concrete winner per layer at Net construction
+# (ops/conv_tune.py) and never reaches conv2d
+CONV_STRATEGIES = ("", "auto", "direct", "im2col", "s2d")
+
+
+def conv_strategy_applicable(strategy: str, x, w, stride, group,
+                             layout: str) -> bool:
+    """Whether a concrete strategy can lower this conv at all (falls back
+    to direct when not — the measured choice only ever picks candidates
+    that pass this)."""
+    if strategy == "s2d":
+        return _s2d_shape_ok(x, w, stride, group, layout)
+    if strategy == "im2col":
+        return group == 1
+    return strategy in ("", "direct")
+
+
+def _conv_im2col(xc, wc, stride, pad, layout: str):
+    """Explicit im2col + GEMM lowering (the reference's conv_layer.cpp
+    matmul over util/im2col.cpp columns; Caffe con Troll's baseline
+    strategy). ``conv_general_dilated_patches`` orders the patch feature
+    dim (c, kh, kw) in both layouts — exactly OIHW's reshape order."""
+    o = wc.shape[0]
+    kern = (wc.shape[2], wc.shape[3])
+    padding = [(pad[0], pad[0]), (pad[1], pad[1])]
+    dn = ((layout, "OIHW", layout) if layout == "NHWC"
+          else ("NCHW", "OIHW", "NCHW"))
+    patches = lax.conv_general_dilated_patches(
+        xc, kern, stride, padding, dimension_numbers=dn,
+        precision=matmul_precision())
+    w2 = wc.reshape(o, -1)
+    if layout == "NHWC":
+        n, oh, ow, k = patches.shape
+        y = lax.dot_general(patches.reshape(n * oh * ow, k), w2,
+                            (((1,), (1,)), ((), ())),
+                            precision=matmul_precision())
+        return y.reshape(n, oh, ow, o)
+    n, k, oh, ow = patches.shape
+    y = lax.dot_general(w2, patches.reshape(n, k, oh * ow),
+                        (((1,), (1,)), ((), ())),
+                        precision=matmul_precision())
+    return jnp.transpose(y, (1, 0, 2)).reshape(n, o, oh, ow)
 
 
 def conv2d(
@@ -154,12 +211,22 @@ def conv2d(
     act_slope: float = 0.0,
     scale: Optional[jax.Array] = None,
     shift: Optional[jax.Array] = None,
+    strategy: Optional[str] = None,
 ) -> jax.Array:
     """Convolution with a fused epilogue. ``x`` is in ``layout``; ``w`` is
     ALWAYS canonical OIHW with I = C/group (under NHWC the weight reaches
     the MXU via the dimension-numbers view, never a materialized
     transpose, so the stored/updated/checkpointed layout is one and the
     same). Output is in ``layout``.
+
+    ``strategy`` selects the lowering: "direct" (conv_general_dilated
+    straight onto the MXU), "im2col" (explicit patches + GEMM),
+    "s2d" (the space-to-depth stem rewrite — exact up to float summation
+    order), or None/"" for the legacy behavior (the global ``conv_s2d``
+    policy decides). A strategy that cannot lower this conv (grouped
+    im2col, non-stem s2d) silently takes direct — the per-layer measured
+    choice (core/net.py + ops/conv_tune.py) only ever picks applicable
+    candidates.
 
     Epilogue (fused into the conv consumer so XLA emits one kernel per
     conv layer): ``y = act((conv(x, w) + b) * scale + shift)``, every
@@ -170,22 +237,34 @@ def conv2d(
     p = policy()
     xc = x.astype(p.compute_dtype)
     wc = w.astype(p.compute_dtype)
-    if _s2d_applicable(xc, wc, stride, group, layout):
+    strategy = strategy or ""
+    if strategy not in CONV_STRATEGIES or strategy == "auto":
+        raise ValueError(f"conv2d: unresolved strategy {strategy!r} "
+                         f"(choose from {CONV_STRATEGIES[2:]}; 'auto' is "
+                         f"resolved per layer at Net construction)")
+    use_s2d = (_s2d_applicable(xc, wc, stride, group, layout)
+               if strategy == "" else
+               strategy == "s2d" and _s2d_shape_ok(xc, wc, stride, group,
+                                                   layout))
+    if use_s2d:
         xc, wc = _space_to_depth_rewrite(xc, wc, stride, pad, layout)
         stride = (1, 1)
         pad = (0, 0)
-    padding = [(pad[0], pad[0]), (pad[1], pad[1])]
-    dn = ((layout, "OIHW", layout) if layout == "NHWC"
-          else ("NCHW", "OIHW", "NCHW"))
-    y = lax.conv_general_dilated(
-        xc,
-        wc,
-        window_strides=stride,
-        padding=padding,
-        dimension_numbers=dn,
-        feature_group_count=group,
-        precision=matmul_precision(),
-    )
+    if strategy == "im2col" and group == 1:
+        y = _conv_im2col(xc, wc, stride, pad, layout)
+    else:
+        padding = [(pad[0], pad[0]), (pad[1], pad[1])]
+        dn = ((layout, "OIHW", layout) if layout == "NHWC"
+              else ("NCHW", "OIHW", "NCHW"))
+        y = lax.conv_general_dilated(
+            xc,
+            wc,
+            window_strides=stride,
+            padding=padding,
+            dimension_numbers=dn,
+            feature_group_count=group,
+            precision=matmul_precision(),
+        )
     cshape = (1, 1, 1, -1) if layout == "NHWC" else (1, -1, 1, 1)
     if b is not None:
         y = y + b.reshape(cshape).astype(y.dtype)
@@ -244,20 +323,11 @@ def _pool_dims(x, kernel, stride, pad, layout: str):
     )
 
 
-def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
-                   layout: str = "NCHW"):
-    """Pool via ``lax.reduce_window`` over a Caffe-padded input.
-
-    reduce_window is the TPU-native windowed reduction: XLA lowers its
-    max-backward to one select-and-scatter (first-max-wins on ties, which
-    is Caffe's `>`-update argmax rule, pooling_layer.cpp), where the
-    previous slice-chain formulation transposed into a pile of
-    pad-and-add ops — the round-5 cycle attribution put pooling BACKWARD
-    at 5x its forward and ~23% of the whole AlexNet step
-    (evidence/aot_tpu/layer_cycles.json).
-
-    ``layout`` selects which axes are spatial: (2, 3) for NCHW, (1, 2) for
-    NHWC — the op is layout-native either way (no transposes)."""
+def _pool_pad_crop(x, kernel, stride, pad, oh, ow, fill, layout: str):
+    """The Caffe-padded input, cropped to exactly the extent the oh x ow
+    output grid consumes ((o-1)*s + k per spatial dim): Caffe's ceil-mode
+    output clamp can leave the padded extent larger, and VALID
+    reduce_window would emit extra rows there."""
     ah, aw = spatial_axes(layout)
     h, w = x.shape[ah], x.shape[aw]
     hi_h = max((oh - 1) * stride[0] + kernel[0] - pad[0] - h, 0)
@@ -266,14 +336,30 @@ def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
     pads[ah] = (pad[0], hi_h)
     pads[aw] = (pad[1], hi_w)
     xp = jnp.pad(x, pads, constant_values=fill)
-    # crop to exactly the extent the oh x ow output grid consumes: Caffe's
-    # ceil-mode output clamp can leave the padded extent larger than
-    # (o-1)*s + k, and VALID reduce_window would emit extra rows there
     lo = [0, 0, 0, 0]
     hi = list(xp.shape)
     hi[ah] = (oh - 1) * stride[0] + kernel[0]
     hi[aw] = (ow - 1) * stride[1] + kernel[1]
-    xp = lax.slice(xp, lo, hi)
+    return lax.slice(xp, lo, hi)
+
+
+def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
+                   layout: str = "NCHW"):
+    """Pool via ``lax.reduce_window`` over a Caffe-padded input.
+
+    reduce_window is the TPU-native windowed reduction (the round-5 cycle
+    attribution put the earlier slice-chain FORWARD well behind it);
+    its BACKWARD, however, lowers to select-and-scatter, which the CPU
+    thunk runtime runs as one thunk per window and PR-7's attribution
+    bills as the #1 AlexNet self-time sink — so ``max_pool``/``ave_pool``
+    below carry a custom VJP that never differentiates through this op
+    (strategies: Pallas plane kernel on TPU, vectorized tap-sum on CPU,
+    select-and-scatter kept as the reference arm).
+
+    ``layout`` selects which axes are spatial: (2, 3) for NCHW, (1, 2) for
+    NHWC — the op is layout-native either way (no transposes)."""
+    ah, aw = spatial_axes(layout)
+    xp = _pool_pad_crop(x, kernel, stride, pad, oh, ow, fill, layout)
     window = [1, 1, 1, 1]
     window[ah], window[aw] = kernel
     strides = [1, 1, 1, 1]
@@ -290,21 +376,19 @@ def _window_reduce(x, kernel, stride, pad, oh, ow, fill, combine,
                              tuple(window), tuple(strides), "VALID")
 
 
-def max_pool(x, kernel, stride, pad, layout: str = "NCHW"):
-    _check_layout(layout)
+def _max_pool_ref(x, kernel, stride, pad, layout: str = "NCHW"):
+    """The reduce_window formulation (select-and-scatter backward under
+    plain autodiff) — the forward everywhere, and the reference backward
+    arm the kernel strategies are pinned against."""
     h, w, oh, ow = _pool_dims(x, kernel, stride, pad, layout)
     return _window_reduce(x, kernel, stride, pad, oh, ow,
                           -jnp.inf, jnp.maximum, layout)
 
 
-def ave_pool(x, kernel, stride, pad, layout: str = "NCHW"):
-    _check_layout(layout)
-    h, w, oh, ow = _pool_dims(x, kernel, stride, pad, layout)
-    summed = _window_reduce(x, kernel, stride, pad, oh, ow, 0.0,
-                            lambda a, b: a + b, layout)
-    # Caffe's divisor: window clipped to the padded extent [start, in+pad),
-    # where start may be negative (pooling_layer.cpp:170-180). Static per
-    # position, so compute host-side.
+def _ave_denom(h, w, oh, ow, kernel, stride, pad, layout: str):
+    """Caffe's AVE divisor: window clipped to the padded extent
+    [start, in+pad), where start may be negative
+    (pooling_layer.cpp:170-180). Static per position, so host-side."""
     def divisors(n_out, stride_, pad_, kernel_, in_):
         starts = np.arange(n_out) * stride_ - pad_
         ends = np.minimum(starts + kernel_, in_ + pad_)
@@ -315,7 +399,207 @@ def ave_pool(x, kernel, stride, pad, layout: str = "NCHW"):
     denom = np.outer(dh, dw)
     if layout == "NHWC":
         denom = denom[:, :, None]  # broadcast over minor channels
+    return denom
+
+
+def _ave_pool_ref(x, kernel, stride, pad, layout: str = "NCHW"):
+    h, w, oh, ow = _pool_dims(x, kernel, stride, pad, layout)
+    summed = _window_reduce(x, kernel, stride, pad, oh, ow, 0.0,
+                            lambda a, b: a + b, layout)
+    denom = _ave_denom(h, w, oh, ow, kernel, stride, pad, layout)
     return summed / jnp.asarray(denom, x.dtype)
+
+
+# ---- pooling backward strategies ------------------------------------------ #
+
+# above this many window taps the unrolled tap-sum/kernel loops stop making
+# sense (a global pool is one window: its backward is a broadcast, which is
+# exactly what select-and-scatter degenerates to) — route to the reference
+POOL_TAPS_CAP = 64
+
+
+def _pool_bwd_strategy(kernel) -> str:
+    """'pallas' | 'taps' | 'sas' (select-and-scatter via plain autodiff).
+    Measured defaults: the Pallas plane kernel on real TPU, the vectorized
+    tap-sum elsewhere (one strided-slice/pad-and-add pair per window tap —
+    what removes the per-window thunk chain from the CPU attribution
+    table). ``POSEIDON_POOL_BWD`` forces an arm for A/B."""
+    import os
+    env = os.environ.get("POSEIDON_POOL_BWD", "")
+    if env in ("pallas", "taps", "sas"):
+        return env
+    if kernel[0] * kernel[1] > POOL_TAPS_CAP:
+        return "sas"
+    from .pallas_kernels import _interpret_default
+    return "taps" if _interpret_default() else "pallas"
+
+
+def _pool_flat_ids(shape, ah, aw, pw, stride, dh, dw):
+    """Flat padded-plane index of the tap (dh, dw) of every window, as an
+    int32 array broadcast over the cotangent's shape."""
+    ioh = lax.broadcasted_iota(jnp.int32, shape, ah)
+    iow = lax.broadcasted_iota(jnp.int32, shape, aw)
+    return (ioh * stride[0] + dh) * pw + (iow * stride[1] + dw)
+
+
+def _pool_max_args(xp, g_shape, kernel, stride, layout: str):
+    """Per-window max and FIRST-wins argmax (Caffe's `>`-update rule)
+    recomputed from the padded plane with k*k strided slices — vectorized
+    over every window at once."""
+    ah, aw = spatial_axes(layout)
+    oh, ow = g_shape[ah], g_shape[aw]
+    pw = xp.shape[aw]
+    xf = xp.astype(jnp.float32)
+    mx = jnp.full(g_shape, -jnp.inf, jnp.float32)
+    arg = jnp.zeros(g_shape, jnp.int32)
+    for dh in range(kernel[0]):
+        for dw in range(kernel[1]):
+            lo = [0] * 4
+            hi = list(xp.shape)
+            strides = [1] * 4
+            lo[ah], hi[ah], strides[ah] = (
+                dh, dh + stride[0] * (oh - 1) + 1, stride[0])
+            lo[aw], hi[aw], strides[aw] = (
+                dw, dw + stride[1] * (ow - 1) + 1, stride[1])
+            v = lax.slice(xf, lo, hi, strides)
+            flat = _pool_flat_ids(g_shape, ah, aw, pw, stride, dh, dw)
+            better = v > mx
+            mx = jnp.where(better, v, mx)
+            arg = jnp.where(better, flat, arg)
+    return arg
+
+
+def _pool_scatter_taps(contrib_of, g_shape, ph, pw, kernel, stride,
+                       layout: str):
+    """Scatter per-window contributions back onto the padded plane: one
+    interior-dilated lax.pad + add per window tap (k*k total, each a fused
+    elementwise XLA op — the CPU replacement for one-thunk-per-window
+    select-and-scatter)."""
+    ah, aw = spatial_axes(layout)
+    oh, ow = g_shape[ah], g_shape[aw]
+    dxp = None
+    for dh in range(kernel[0]):
+        for dw in range(kernel[1]):
+            cfg = [(0, 0, 0)] * 4
+            cfg[ah] = (dh, ph - dh - (stride[0] * (oh - 1) + 1),
+                       stride[0] - 1)
+            cfg[aw] = (dw, pw - dw - (stride[1] * (ow - 1) + 1),
+                       stride[1] - 1)
+            piece = lax.pad(contrib_of(dh, dw), jnp.float32(0), cfg)
+            dxp = piece if dxp is None else dxp + piece
+    return dxp
+
+
+def _pool_unpad(dxp, x_shape, pad, layout: str):
+    """d(padded, cropped plane) -> dx: drop the pad rows/cols, zero-fill
+    any input extent the ceil-mode crop never consumed."""
+    ah, aw = spatial_axes(layout)
+    h, w = x_shape[ah], x_shape[aw]
+    ph, pw = dxp.shape[ah], dxp.shape[aw]
+    grow = [(0, 0)] * 4
+    grow[ah] = (0, max(pad[0] + h - ph, 0))
+    grow[aw] = (0, max(pad[1] + w - pw, 0))
+    if any(g != (0, 0) for g in grow):
+        dxp = jnp.pad(dxp, grow)
+    lo = [0] * 4
+    hi = list(dxp.shape)
+    lo[ah], hi[ah] = pad[0], pad[0] + h
+    lo[aw], hi[aw] = pad[1], pad[1] + w
+    return lax.slice(dxp, lo, hi)
+
+
+def _pool_bwd(x, g, kernel, stride, pad, layout: str, method: str):
+    """Route one pooling backward through the chosen strategy."""
+    ah, aw = spatial_axes(layout)
+    h, w, oh, ow = _pool_dims(x, kernel, stride, pad, layout)
+    ph = stride[0] * (oh - 1) + kernel[0]
+    pw = stride[1] * (ow - 1) + kernel[1]
+    strategy = _pool_bwd_strategy(kernel)
+    if strategy == "pallas":
+        from .pallas_kernels import pool_plane_feasible
+        if not pool_plane_feasible(ph, pw, oh, ow, kernel):
+            strategy = "taps"
+    if strategy == "sas":
+        ref = _max_pool_ref if method == "max" else _ave_pool_ref
+        _, vjp = jax.vjp(lambda x_: ref(x_, kernel, stride, pad, layout), x)
+        return vjp(g)[0]
+
+    gf = g.astype(jnp.float32)
+    if method == "ave":
+        denom = _ave_denom(h, w, oh, ow, kernel, stride, pad, layout)
+        gf = gf / jnp.asarray(denom, jnp.float32)
+        xp = None
+    else:
+        xp = _pool_pad_crop(x, kernel, stride, pad, oh, ow, -jnp.inf,
+                            layout)
+
+    if strategy == "pallas":
+        from .pallas_kernels import pool_bwd_plane
+        to_nchw = layout == "NHWC"
+        xpk = None
+        if method == "max":
+            # finite fill: the kernel's selection MATMULS would turn an
+            # -inf pad into 0 * -inf = NaN; finfo.min loses every
+            # comparison against real data, and a degenerate all-pad
+            # window routes its cotangent to a pad position that
+            # _pool_unpad drops — same zero gradient as the -inf arm
+            xpk = _pool_pad_crop(x.astype(jnp.float32), kernel, stride,
+                                 pad, oh, ow,
+                                 float(np.finfo(np.float32).min), layout)
+            if to_nchw:
+                xpk = nhwc_to_nchw(xpk)
+        gk = nhwc_to_nchw(gf) if to_nchw else gf
+        dxp = pool_bwd_plane(xpk, gk, kernel, stride, method)
+        if to_nchw:
+            dxp = nchw_to_nhwc(dxp)
+    else:                                   # taps
+        if method == "max":
+            arg = _pool_max_args(xp, g.shape, kernel, stride, layout)
+            pw_ = xp.shape[aw]
+
+            def contrib_of(dh, dw):
+                flat = _pool_flat_ids(g.shape, ah, aw, pw_, stride, dh, dw)
+                return jnp.where(arg == flat, gf, 0.0)
+        else:
+            def contrib_of(dh, dw):
+                return gf
+        dxp = _pool_scatter_taps(contrib_of, g.shape, ph, pw, kernel,
+                                 stride, layout)
+    return _pool_unpad(dxp, x.shape, pad, layout).astype(x.dtype)
+
+
+def _make_pool_cvjp(method: str, ref):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+    def pool(x, kernel, stride, pad, layout):
+        return ref(x, kernel, stride, pad, layout)
+
+    def fwd(x, kernel, stride, pad, layout):
+        # x is the only residual: the max backward recomputes the argmax
+        # from it, the ave backward reads only its (static) shape — XLA
+        # DCEs the buffer out of the saved set in that case
+        return ref(x, kernel, stride, pad, layout), x
+
+    def bwd(kernel, stride, pad, layout, x, g):
+        return (_pool_bwd(x, g, kernel, stride, pad, layout, method),)
+
+    pool.defvjp(fwd, bwd)
+    return pool
+
+
+_max_pool_cvjp = _make_pool_cvjp("max", _max_pool_ref)
+_ave_pool_cvjp = _make_pool_cvjp("ave", _ave_pool_ref)
+
+
+def max_pool(x, kernel, stride, pad, layout: str = "NCHW"):
+    _check_layout(layout)
+    return _max_pool_cvjp(x, tuple(kernel), tuple(stride), tuple(pad),
+                          layout)
+
+
+def ave_pool(x, kernel, stride, pad, layout: str = "NCHW"):
+    _check_layout(layout)
+    return _ave_pool_cvjp(x, tuple(kernel), tuple(stride), tuple(pad),
+                          layout)
 
 
 def global_ave_pool(x, layout: str = "NCHW"):
@@ -346,22 +630,73 @@ def stochastic_pool(x, kernel, stride, pad, rng, train: bool,
 # --------------------------------------------------------------------------- #
 
 
-def lrn_across_channels(x, local_size: int, alpha: float, beta: float,
-                        k: float = 1.0, layout: str = "NCHW"):
-    _check_layout(layout)
+def _lrn_window_sum(t, pre: int, post: int, ca: int):
+    """Cross-channel windowed sum: pad (pre, post) on the channel axis and
+    add the ``local_size`` shifted slices."""
+    c = t.shape[ca]
+    pads = [(0, 0)] * 4
+    pads[ca] = (pre, post)
+    tp = jnp.pad(t, pads)
+    out = None
+    for dc in range(pre + post + 1):
+        sl = lax.slice_in_dim(tp, dc, dc + c, axis=ca)
+        out = sl if out is None else out + sl
+    return out
+
+
+def _lrn_ac_raw(x, local_size: int, alpha: float, beta: float, k: float,
+                layout: str):
     pre_pad = (local_size - 1) // 2
     post_pad = local_size - pre_pad - 1
     ca = channel_axis(layout)
-    c = x.shape[ca]
-    pads = [(0, 0)] * 4
-    pads[ca] = (pre_pad, post_pad)
-    sq = jnp.pad(x * x, pads)
-    windowed = None
-    for dc in range(local_size):
-        sl = lax.slice_in_dim(sq, dc, dc + c, axis=ca)
-        windowed = sl if windowed is None else windowed + sl
+    windowed = _lrn_window_sum(x * x, pre_pad, post_pad, ca)
     scale = k + (alpha / local_size) * windowed
     return x * scale ** (-beta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _lrn_ac_cvjp(x, local_size: int, alpha: float, beta: float, k: float,
+                 layout: str):
+    return _lrn_ac_raw(x, local_size, alpha, beta, k, layout)
+
+
+def _lrn_ac_fwd(x, local_size, alpha, beta, k, layout):
+    return _lrn_ac_raw(x, local_size, alpha, beta, k, layout), x
+
+
+def _lrn_ac_bwd(local_size, alpha, beta, k, layout, x, g):
+    """The analytic Caffe gradient (lrn_layer.cpp CrossChannelBackward) in
+    plain XLA ops — the same one-pass math the Pallas bwd kernel runs,
+    here as the portable fallback. Plain autodiff through the forward
+    instead transposes the pow/product chain into roughly twice the work;
+    the PR-7 attribution billed LRN backward at ~2/3 of the norm layers'
+    cost. The transpose window mirrors the forward's (pad (post, pre))."""
+    pre = (local_size - 1) // 2
+    post = local_size - pre - 1
+    ca = channel_axis(layout)
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    scale = k + (alpha / local_size) * _lrn_window_sum(xf * xf, pre, post,
+                                                       ca)
+    r = gf * xf * scale ** (-beta - 1.0)
+    rsum = _lrn_window_sum(r, post, pre, ca)
+    dx = gf * scale ** (-beta) - (2.0 * alpha * beta / local_size) * xf * rsum
+    return (dx.astype(x.dtype),)
+
+
+_lrn_ac_cvjp.defvjp(_lrn_ac_fwd, _lrn_ac_bwd)
+
+
+def lrn_across_channels(x, local_size: int, alpha: float, beta: float,
+                        k: float = 1.0, layout: str = "NCHW"):
+    """ACROSS_CHANNELS LRN, XLA formulation, with the analytic Caffe
+    backward as a custom VJP (``POSEIDON_LRN_BWD=autodiff`` restores plain
+    autodiff through the forward, the A/B reference arm)."""
+    import os
+    _check_layout(layout)
+    if os.environ.get("POSEIDON_LRN_BWD") == "autodiff":
+        return _lrn_ac_raw(x, local_size, alpha, beta, k, layout)
+    return _lrn_ac_cvjp(x, local_size, alpha, beta, k, layout)
 
 
 def lrn_within_channel(x, local_size: int, alpha: float, beta: float,

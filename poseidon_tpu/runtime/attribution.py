@@ -216,8 +216,6 @@ def load_trace_events(trace_dir: str) -> List[Dict]:
 # HLO instruction -> layer scope (the join key)
 # --------------------------------------------------------------------------- #
 
-_WRAPPER = re.compile(r"^([\w.\-]+)\((.*)\)$")
-
 # transform wrappers that PRESERVE the scope they wrap (peel to the
 # inside); anything else in wrapper(..) form — jit(fn), pjit(fn), named
 # computation frames — is a CALL frame whose argument is a function name,
@@ -229,17 +227,35 @@ _PEELABLE = frozenset({
     "custom_vjp_call",
 })
 
+_WRAP_OPEN = re.compile(r"^([\w.\-]+)\(")
 
-def _peel(component: str) -> Optional[str]:
-    """'transpose(jvp(conv1))' -> 'conv1'; 'jit(loss)' -> None (a call
-    frame, not a scope)."""
-    while True:
-        m = _WRAPPER.match(component)
-        if not m:
-            return component
-        if m.group(1) not in _PEELABLE:
-            return None
-        component = m.group(2)
+
+def _scope_components(op_name: str) -> List[str]:
+    """Path components with wrappers peeled — aware that a SLASHED scope
+    name splits a wrapper across components: in
+    'transpose(jvp(inception_3a/3x3))/conv', the wrapper opens in the
+    'transpose(jvp(inception_3a' component and closes two components
+    later, so per-component peeling (the old ``_peel``) mangled every
+    wrapped GoogLeNet scope into 'jvp(inception_3a' + '3x3)' and the
+    whole model fell into the residual row. Leading PEELABLE wrapper
+    opens are stripped wherever they appear, call frames (jit(fn)) drop
+    their component entirely, and trailing close-parens — ours or an
+    enclosing component's — are shed."""
+    comps: List[str] = []
+    for comp in op_name.split("/"):
+        while True:
+            m = _WRAP_OPEN.match(comp)
+            if not m:
+                break
+            if m.group(1) in _PEELABLE:
+                comp = comp[m.end():]
+            else:
+                comp = ""       # call frame: not a scope, drop it
+                break
+        comp = comp.rstrip(")")
+        if comp:
+            comps.append(comp)
+    return comps
 
 
 # collective named scopes emitted by the comm machinery (strategies.py
@@ -288,8 +304,7 @@ def scope_of(op_name: str, layer_names, extra_scopes=frozenset()):
     the comm machinery's per-bucket/per-axis collective scopes
     (``COMM_SCOPE_RE``) are recognized unconditionally so comm time
     lands in named per-axis rows rather than the residual."""
-    comps = [p for p in (_peel(c) for c in op_name.split("/"))
-             if p is not None]
+    comps = _scope_components(op_name)
     joined = "/".join(comps)
     for lname in sorted(layer_names, key=lambda s: -s.count("/")):
         ln = lname.split("/")
@@ -402,6 +417,52 @@ def hlo_scope_map(hlo_text: str, layer_names,
                     break
         if not changed:
             break
+    # DOWNWARD inheritance: XLA:CPU's thunk registry names the CLONED
+    # fusion instruction INSIDE a %parallel_* computation
+    # ('copy_bitcast_fusion.2.clone' in %parallel_copy_bitcast_fusion.2),
+    # which carries no metadata of its own — the upward fixpoint resolves
+    # the CALLER, so push each called computation's caller scope down onto
+    # its unresolved members (majority across call sites, a few levels)
+    for _ in range(8):
+        comp_counts: Dict[str, Dict[Tuple[str, str], int]] = {}
+        for inst, callees in inst_callees.items():
+            s = resolved.get(inst)
+            if s is None:
+                continue
+            for c in callees:
+                cc = comp_counts.setdefault(c, {})
+                cc[s] = cc.get(s, 0) + 1
+        changed = False
+        for c, counts in comp_counts.items():
+            s = max(counts.items(), key=lambda kv: kv[1])[0]
+            for i in comp_insts.get(c, ()):
+                if i not in resolved:
+                    resolved[i] = s
+                    changed = True
+        if not changed:
+            break
+    # last-chance neighbor rescue, ONE snapshot pass: a backend-rewritten
+    # instruction whose metadata is gone AND whose direct-metadata
+    # neighbors are all metadata-less calls (the CPU layout pass
+    # re-materializing a backward convolution between two parallel calls)
+    # takes the majority scope of its RESOLVED operands/users. Snapshot
+    # semantics — rescued instructions never feed further rescues — so
+    # there is no transitive flooding and the residual row stays honest.
+    snapshot = dict(resolved)
+    inst_operands: Dict[str, List[str]] = {}
+    for op, users in operand_users.items():
+        for u in users:
+            inst_operands.setdefault(u, []).append(op)
+    for inst in {i for insts in comp_insts.values() for i in insts}:
+        if inst in snapshot:
+            continue
+        counts = {}
+        for nb in operand_users.get(inst, []) + inst_operands.get(inst, []):
+            s = snapshot.get(nb)
+            if s is not None:
+                counts[s] = counts.get(s, 0) + 1
+        if counts:
+            resolved[inst] = max(counts.items(), key=lambda kv: kv[1])[0]
     return resolved
 
 

@@ -144,6 +144,13 @@ def cmd_train(args) -> int:
     if args.bf16:
         from .. import config
         config.set_perf_policy()
+    if getattr(args, "conv_strategy", ""):
+        # per-layer lowering-strategy axis: "auto" measures each conv
+        # layer at Net construction (choices logged + persisted through
+        # the compile-cache tuned store); concrete values force one
+        # strategy net-wide, overriding the legacy conv_s2d policy
+        from .. import config
+        config.set_policy(conv_strategy=args.conv_strategy)
     if getattr(args, "async_ssp", False):
         # async-SSP: the processes stay INDEPENDENT jax runtimes — no
         # jax.distributed world, no collective rendezvous; the only
@@ -699,10 +706,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "ordered exact element ranges; <= 0 = one bucket "
                         "per leaf)")
     t.add_argument("--bf16", action="store_true",
-                   help="the bf16 perf config: bfloat16 compute (MXU-"
-                        "native) + the exact space-to-depth stem rewrite; "
-                        "params/updates stay f32. Default f32 matches "
-                        "Caffe numerics exactly (direct conv1 formulation)")
+                   help="the documented bf16 training path: bfloat16 "
+                        "compute (MXU-native) + the exact space-to-depth "
+                        "stem rewrite; params/optimizer state/softmax "
+                        "stats stay f32. Accuracy guardrail: the LeNet "
+                        "loss-trajectory smoke must track f32 within "
+                        "numeric.BF16_SMOKE_* (tests/test_kernels.py). "
+                        "Default f32 matches Caffe numerics exactly")
+    t.add_argument("--conv_strategy", default="",
+                   choices=["", "auto", "direct", "im2col", "s2d"],
+                   help="conv lowering strategy: 'auto' MEASURES direct/"
+                        "im2col/s2d per conv layer at net construction "
+                        "(short micro-runs; winners logged and persisted "
+                        "via --compile_cache_dir so the next run skips "
+                        "re-measurement), a concrete value forces one "
+                        "strategy net-wide; empty = the legacy global "
+                        "conv_s2d policy (on under --bf16)")
     t.add_argument("--mesh", default="",
                    help="named SPMD mesh spec, e.g. 'dp2,fsdp2,tp1' "
                         "(axes: dp = data parallel, fsdp = sharded "

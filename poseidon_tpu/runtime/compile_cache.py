@@ -39,7 +39,8 @@ from typing import Any, Dict, Optional
 
 __all__ = ["enable_compile_cache", "disable_compile_cache",
            "cache_entries", "step_key",
-           "save_step_executable", "load_step_executable", "aot_entries"]
+           "save_step_executable", "load_step_executable", "aot_entries",
+           "load_tuned", "save_tuned", "tuned_path"]
 
 
 def enable_compile_cache(cache_dir: str,
@@ -201,3 +202,53 @@ def describe(cache_dir: str) -> Dict[str, int]:
     """Telemetry: entry counts for stats/bench output."""
     return {"xla_cache_entries": cache_entries(cache_dir),
             "aot_step_entries": aot_entries(cache_dir)}
+
+
+# --------------------------------------------------------------------------- #
+# Tuned-policy store: measured decisions persisted next to the executables
+# --------------------------------------------------------------------------- #
+#
+# The per-layer conv lowering-strategy choice (ops/conv_tune.py) is a
+# MEASURED decision keyed by (layer shape, backend, device kind) — the same
+# restart economics as the AOT executables above, so it lives in the same
+# cache directory: a restarted (or brand-new, elastically admitted) process
+# with the same job config loads the winner instead of re-measuring. One
+# JSON file per (namespace, key), atomic rename, any read failure = clean
+# miss. ROADMAP item 5's general `tune` mode is this store grown one
+# namespace per policy knob.
+
+def tuned_path(cache_dir: str, namespace: str, key: str) -> str:
+    return os.path.join(cache_dir, "tuned", f"{namespace}-{key}.json")
+
+
+def load_tuned(cache_dir: str, namespace: str, key: str) -> Optional[Dict]:
+    """The persisted decision document, or None on miss/any failure (a
+    torn or foreign entry degrades to a re-measure, never an abort)."""
+    if not cache_dir:
+        return None
+    try:
+        with open(tuned_path(cache_dir, namespace, key)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def save_tuned(cache_dir: str, namespace: str, key: str,
+               doc: Dict) -> Optional[str]:
+    """Persist a decision document (atomic tmp + rename). Best-effort:
+    returns the path, or None when the store is disabled/unwritable."""
+    if not cache_dir:
+        return None
+    path = tuned_path(cache_dir, namespace, key)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+    except OSError as e:
+        from .metrics import log
+        log(f"compile_cache: tuned entry {namespace}-{key} not persisted "
+            f"({e}); will re-measure next process")
+        return None

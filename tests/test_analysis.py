@@ -633,6 +633,70 @@ def test_traced_function_resolved_at_depth_and_reported_once():
     assert [f.symbol for f in hits] == ["step.inner"], out
 
 
+_PALLAS_KERNEL_SRC = """
+    import functools
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    def _my_kernel(x_ref, o_ref, *, tile):
+        offs = np.asarray(range(tile))        # static index math: fine
+        o_ref[...] = x_ref[...] * {payload}
+
+    def run(x):
+        return pl.pallas_call(
+            functools.partial(_my_kernel, tile=8),
+            out_shape=x)(x)
+"""
+
+
+def test_pallas_kernel_body_np_static_math_not_flagged():
+    """The carve-out: np.* inside a Pallas kernel body is trace-time
+    constant math on static shapes — there is no device value to sync —
+    so the host-sync rule must stay quiet there."""
+    out = _jit(_PALLAS_KERNEL_SRC.format(payload="offs.sum()"))
+    assert not [f for f in out if f.rule == "JIT101"], out
+
+
+def test_pallas_kernel_body_real_sync_still_fires():
+    """.item() (or device_get) inside a kernel body cannot lower at all —
+    the kernel-body exemption must NOT blind the rule to it."""
+    out = _jit(_PALLAS_KERNEL_SRC.format(payload="x_ref[0].item()"))
+    hits = [f for f in out if f.rule == "JIT101" and f.key == ".item()"]
+    assert [f.symbol for f in hits] == ["_my_kernel"], out
+    assert "Pallas kernel body" in hits[0].message
+
+
+def test_experimental_tracing_wrapper_still_linted():
+    """The jax.experimental import branch (pallas detection) must not
+    shadow TRACING_WRAPPERS resolution: a shard_map imported from
+    jax.experimental.shard_map still traces its function."""
+    out = _jit("""
+        from jax.experimental.shard_map import shard_map
+
+        @shard_map
+        def step(x):
+            return x.item()
+    """)
+    assert any(f.rule == "JIT101" and f.key == ".item()"
+               and f.symbol == "step" for f in out), out
+
+
+def test_pallas_kernel_detected_through_direct_reference():
+    """pallas_call(kernel) without the functools.partial wrapper, via the
+    bare-name import form."""
+    out = _jit("""
+        from jax.experimental.pallas import pallas_call
+
+        def _k(x_ref, o_ref):
+            o_ref[...] = x_ref[...].item()
+
+        def run(x):
+            return pallas_call(_k, out_shape=x)(x)
+    """)
+    assert any(f.rule == "JIT101" and f.symbol == "_k"
+               and f.key == ".item()" for f in out), out
+
+
 def test_bound_method_passed_to_jit_is_traced():
     """jax.jit(self._fwd) marks the sibling method traced — the serving
     executor traces its step exactly this way, so a Name-only resolver

@@ -244,9 +244,20 @@ def test_scope_of_peels_autodiff_wrappers_and_slashed_names():
         ("conv1", "bwd")
     assert A.scope_of("jit(f)/jvp(inception_3a)/1x1/conv", layers) == \
         ("inception_3a/1x1", "fwd")
+    # what jax ACTUALLY emits for a slashed layer name: the wrapper opens
+    # and closes in DIFFERENT '/'-components — per-component peeling used
+    # to mangle this into 'jvp(inception_3a' + '1x1)' and every wrapped
+    # GoogLeNet op fell into the residual row
+    assert A.scope_of("jit(f)/jvp(inception_3a/1x1)/conv", layers) == \
+        ("inception_3a/1x1", "fwd")
+    assert A.scope_of("jit(f)/transpose(jvp(inception_3a/1x1))/conv",
+                      layers) == ("inception_3a/1x1", "bwd")
     assert A.scope_of("jit(f)/arena_pack/concatenate", layers,
                       {"arena_pack"}) == ("arena_pack", "misc")
     assert A.scope_of("jit(f)/unrelated/op", layers) == (None, None)
+    # a call frame whose function name collides with a layer must still
+    # NOT attribute (jit(conv1) is the traced function, not the layer)
+    assert A.scope_of("jit(conv1)/add", layers) == (None, None)
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,449 @@
+"""Kernel parity + conv-strategy + bf16 guardrails (the MFU-sink PR).
+
+Three contracts, each pinned against the formulation it replaces:
+
+- **pool backward**: the custom-VJP strategies (Pallas plane kernel in
+  interpret mode; vectorized tap-sum) must match the select-and-scatter
+  reference arm — f32 tolerance and bf16, both layouts, first-max-wins
+  ties included, with the VMEM/taps-cap fallbacks routing safely;
+- **LRN**: Pallas fwd+bwd parity vs the XLA formulation in both layouts
+  (f32 + bf16) and the routing defaults (XLA off-TPU, Pallas on TPU,
+  ``POSEIDON_PALLAS_LRN=0`` opt-out, VMEM-cap fallback);
+- **conv strategy**: direct/im2col/s2d lowering parity (fwd + dx/dw, both
+  layouts), per-layer measured resolution with persistence through the
+  compile-cache tuned store, and the ``--bf16`` LeNet smoke training to a
+  loss within ``numeric.BF16_SMOKE_*`` of the f32 run.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu import config
+from poseidon_tpu.config import policy_scope
+from poseidon_tpu.ops import nn as NN
+
+N_DEV = 8
+
+
+@pytest.fixture()
+def rng_np():
+    return np.random.RandomState(0)
+
+
+@pytest.fixture()
+def pool_env(monkeypatch):
+    def force(strategy):
+        monkeypatch.setenv("POSEIDON_POOL_BWD", strategy)
+    return force
+
+
+POOL_GEOMS = [
+    ((3, 3), (2, 2), (0, 0), 9),    # AlexNet-style overlapping pool
+    ((3, 3), (2, 2), (1, 1), 8),    # padded + ceil-mode clamp
+    ((2, 2), (2, 2), (0, 0), 8),    # LeNet non-overlapping
+    ((5, 5), (3, 3), (2, 2), 11),   # larger window, uneven coverage
+    ((3, 3), (1, 1), (1, 1), 7),    # stride 1 (the LRN-within path)
+]
+
+
+def _pool_grad(fn, x, k, s, p, layout):
+    f = lambda x_: jnp.sum(fn(x_, k, s, p, layout).astype(jnp.float32) ** 2)
+    return np.asarray(jax.grad(f)(x))
+
+
+@pytest.mark.parametrize("method", ["max", "ave"])
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+@pytest.mark.parametrize("geom", POOL_GEOMS)
+def test_pool_bwd_strategies_match_reference(rng_np, pool_env, method,
+                                             layout, geom):
+    """taps and (interpret-mode) pallas backward == select-and-scatter."""
+    k, s, p, h = geom
+    fn = NN.max_pool if method == "max" else NN.ave_pool
+    x = rng_np.randn(2, 5, h, h).astype(np.float32)
+    if layout == "NHWC":
+        x = np.transpose(x, (0, 2, 3, 1)).copy()
+    x = jnp.asarray(x)
+    pool_env("sas")
+    ref = _pool_grad(fn, x, k, s, p, layout)
+    for strategy in ("taps", "pallas"):
+        pool_env(strategy)
+        got = _pool_grad(fn, x, k, s, p, layout)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5,
+                                   err_msg=f"{method}/{strategy}/{layout}")
+
+
+@pytest.mark.parametrize("method", ["max", "ave"])
+def test_pool_bwd_bf16(rng_np, pool_env, method):
+    """bf16 activations: kernel strategies track the reference within
+    bf16 resolution (the kernels recompute/accumulate in f32)."""
+    fn = NN.max_pool if method == "max" else NN.ave_pool
+    x = jnp.asarray(rng_np.randn(2, 4, 9, 9).astype(np.float32)).astype(
+        jnp.bfloat16)
+    pool_env("sas")
+    ref = _pool_grad(fn, x, (3, 3), (2, 2), (0, 0), "NCHW").astype(
+        np.float32)
+    for strategy in ("taps", "pallas"):
+        pool_env(strategy)
+        got = _pool_grad(fn, x, (3, 3), (2, 2), (0, 0), "NCHW").astype(
+            np.float32)
+        np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.1,
+                                   err_msg=f"{method}/{strategy}")
+
+
+def test_pool_bwd_first_max_wins_ties(pool_env):
+    """Constant input: EVERY window position ties, so any argmax
+    divergence from Caffe's first-wins `>`-update rule shows up bitwise."""
+    x = jnp.ones((1, 3, 8, 8), jnp.float32)
+    pool_env("sas")
+    ref = _pool_grad(NN.max_pool, x, (3, 3), (2, 2), (1, 1), "NCHW")
+    for strategy in ("taps", "pallas"):
+        pool_env(strategy)
+        got = _pool_grad(NN.max_pool, x, (3, 3), (2, 2), (1, 1), "NCHW")
+        np.testing.assert_array_equal(got, ref, err_msg=strategy)
+
+
+def test_pool_bwd_strategy_routing(monkeypatch):
+    from poseidon_tpu.ops.nn import POOL_TAPS_CAP, _pool_bwd_strategy
+    monkeypatch.delenv("POSEIDON_POOL_BWD", raising=False)
+    # off-TPU default: taps (the CPU thunk-runtime win)
+    assert _pool_bwd_strategy((3, 3)) == "taps"
+    # a global pool's window exceeds the taps cap: the reference arm
+    # (select-and-scatter degenerates to a broadcast there anyway)
+    assert _pool_bwd_strategy((9, 9)) == "sas"
+    assert 9 * 9 > POOL_TAPS_CAP
+    # on-TPU default: the Pallas plane kernel
+    monkeypatch.setattr("poseidon_tpu.ops.pallas_kernels._interpret_default",
+                        lambda: False)
+    assert _pool_bwd_strategy((3, 3)) == "pallas"
+    # explicit override always wins
+    monkeypatch.setenv("POSEIDON_POOL_BWD", "sas")
+    assert _pool_bwd_strategy((3, 3)) == "sas"
+
+
+def test_pool_plane_feasibility_guard(rng_np, pool_env, monkeypatch):
+    """An infeasible plane under forced-pallas must fall back to taps (and
+    still be correct), never die in the kernel."""
+    from poseidon_tpu.ops.pallas_kernels import pool_plane_feasible
+    assert pool_plane_feasible(55, 55, 27, 27, (3, 3))
+    assert not pool_plane_feasible(55, 55, 27, 27, (9, 9))   # taps blowup
+    assert not pool_plane_feasible(4000, 4000, 2000, 2000, (3, 3))  # VMEM
+    x = jnp.asarray(rng_np.randn(1, 2, 9, 9).astype(np.float32))
+    pool_env("sas")
+    ref = _pool_grad(NN.max_pool, x, (3, 3), (2, 2), (0, 0), "NCHW")
+    monkeypatch.setattr("poseidon_tpu.ops.pallas_kernels.pool_plane_feasible",
+                        lambda *a: False)
+    pool_env("pallas")
+    got = _pool_grad(NN.max_pool, x, (3, 3), (2, 2), (0, 0), "NCHW")
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pool_bwd_under_jit_and_in_net(rng_np, pool_env):
+    """The custom VJP composes with jit and a whole-net backward: LeNet
+    gradients under taps == under the reference arm."""
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    net = Net(zoo.lenet(with_accuracy=False), phase="TRAIN",
+              source_shapes=zoo.lenet_shapes(4))
+    params = net.init(jax.random.PRNGKey(0))
+    batch = {"data": jnp.asarray(rng_np.randn(4, 1, 28, 28)
+                                 .astype(np.float32)),
+             "label": jnp.asarray(rng_np.randint(0, 10, size=(4,)))}
+
+    def loss(p):
+        return net.apply(p, batch, rng=jax.random.PRNGKey(1)).loss
+
+    grads = {}
+    for strategy in ("sas", "taps"):
+        pool_env(strategy)
+        jax.clear_caches()     # the strategy is read at trace time
+        grads[strategy] = jax.jit(jax.grad(loss))(params)
+    for lname in grads["sas"]:
+        for pname in grads["sas"][lname]:
+            np.testing.assert_allclose(
+                np.asarray(grads["taps"][lname][pname]),
+                np.asarray(grads["sas"][lname][pname]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{lname}/{pname}")
+
+
+# --------------------------------------------------------------------------- #
+# LRN
+# --------------------------------------------------------------------------- #
+
+def test_lrn_routing_defaults(monkeypatch):
+    """Off-TPU: XLA formulation. On TPU (mocked): Pallas by default,
+    POSEIDON_PALLAS_LRN=0 opts out."""
+    from poseidon_tpu.ops import pallas_kernels as PK
+    x = jnp.ones((1, 4, 4, 4), jnp.float32)
+    calls = []
+    monkeypatch.setattr(PK, "lrn_fused",
+                        lambda *a, **kw: calls.append("pallas") or x)
+    monkeypatch.delenv("POSEIDON_PALLAS_LRN", raising=False)
+    PK.maybe_lrn_fused(x, 5, 1e-4, 0.75)          # CPU: XLA
+    assert calls == []
+    monkeypatch.setattr(PK, "_interpret_default", lambda: False)
+    PK.maybe_lrn_fused(x, 5, 1e-4, 0.75)          # "TPU": Pallas default
+    assert calls == ["pallas"]
+    monkeypatch.setenv("POSEIDON_PALLAS_LRN", "0")
+    PK.maybe_lrn_fused(x, 5, 1e-4, 0.75)          # opt-out honored
+    assert calls == ["pallas"]
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_lrn_fwd_bwd_parity_f32(rng_np, layout):
+    """Pallas LRN fwd + analytic bwd kernels (interpret mode) vs the XLA
+    formulation, through the custom-VJP gradient path."""
+    from poseidon_tpu.ops.pallas_kernels import lrn_fused, lrn_fused_bwd
+    from poseidon_tpu.ops.nn import lrn_across_channels
+    x = rng_np.randn(2, 16, 5, 5).astype(np.float32)
+    if layout == "NHWC":
+        x = np.transpose(x, (0, 2, 3, 1)).copy()
+    xj = jnp.asarray(x)
+    want = np.asarray(lrn_across_channels(xj, 5, 1e-4, 0.75, 1.0, layout))
+    got = np.asarray(lrn_fused(xj, 5, 1e-4, 0.75, 1.0, layout=layout))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    f_ref = lambda x_: jnp.sum(
+        lrn_across_channels(x_, 5, 1e-4, 0.75, 1.0, layout) ** 2)
+    dref = np.asarray(jax.grad(f_ref)(xj))
+    g = jax.grad(lambda x_: jnp.sum(
+        lrn_across_channels(x_, 5, 1e-4, 0.75, 1.0, layout) ** 2))(xj)
+    # the standalone analytic backward kernel, driven by the same upstream
+    # cotangent the squared-sum loss produces
+    y = lrn_across_channels(xj, 5, 1e-4, 0.75, 1.0, layout)
+    dk = np.asarray(lrn_fused_bwd(xj, 2.0 * y, 5, 1e-4, 0.75, 1.0,
+                                  interpret=True, layout=layout))
+    np.testing.assert_allclose(dk, dref, rtol=1e-4, atol=1e-5)
+    assert g.shape == xj.shape
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_lrn_parity_bf16(rng_np, layout):
+    from poseidon_tpu.ops.pallas_kernels import lrn_fused
+    from poseidon_tpu.ops.nn import lrn_across_channels
+    x = rng_np.randn(2, 16, 5, 5).astype(np.float32)
+    if layout == "NHWC":
+        x = np.transpose(x, (0, 2, 3, 1)).copy()
+    xb = jnp.asarray(x).astype(jnp.bfloat16)
+    want = np.asarray(lrn_across_channels(xb, 5, 1e-4, 0.75, 1.0,
+                                          layout)).astype(np.float32)
+    got = np.asarray(lrn_fused(xb, 5, 1e-4, 0.75, 1.0,
+                               layout=layout)).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+@pytest.mark.parametrize("local_size,k", [(5, 1.0), (4, 1.5)])
+def test_lrn_analytic_xla_bwd_matches_autodiff(rng_np, monkeypatch, layout,
+                                               local_size, k):
+    """The XLA fallback's analytic custom-VJP backward (what CPU runs by
+    default now) == plain autodiff through the forward, odd AND even
+    windows, both layouts."""
+    from poseidon_tpu.ops.nn import lrn_across_channels
+    x = rng_np.randn(2, 16, 4, 4).astype(np.float32)
+    if layout == "NHWC":
+        x = np.transpose(x, (0, 2, 3, 1)).copy()
+    xj = jnp.asarray(x)
+    f = lambda x_: jnp.sum(
+        lrn_across_channels(x_, local_size, 2e-4, 0.75, k, layout) ** 2)
+    monkeypatch.setenv("POSEIDON_LRN_BWD", "autodiff")
+    want = np.asarray(jax.grad(f)(xj))
+    monkeypatch.delenv("POSEIDON_LRN_BWD")
+    got = np.asarray(jax.grad(f)(xj))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_lrn_vmem_cap_falls_back_with_grad():
+    """Beyond the ~2560-channel tile cap, lrn_fused silently takes the
+    XLA formulation — forward AND backward stay usable."""
+    from poseidon_tpu.ops.pallas_kernels import lrn_fused, lrn_tile_feasible
+    assert not lrn_tile_feasible(81, 4096)
+    x = jnp.ones((1, 4096, 9, 9), jnp.float32)
+    y = lrn_fused(x, 5, 1e-4, 0.75)
+    g = jax.grad(lambda x_: jnp.sum(lrn_fused(x_, 5, 1e-4, 0.75) ** 2))(x)
+    assert y.shape == x.shape and g.shape == x.shape
+
+
+# --------------------------------------------------------------------------- #
+# conv strategies
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+@pytest.mark.parametrize("strategy", ["im2col", "s2d"])
+def test_conv_strategy_parity(rng_np, layout, strategy):
+    """Every lowering computes the direct conv's numbers (fwd, dx, dw)."""
+    x = rng_np.randn(2, 3, 13, 13).astype(np.float32)
+    w = rng_np.randn(8, 3, 3, 3).astype(np.float32)
+    b = rng_np.randn(8).astype(np.float32)
+    if layout == "NHWC":
+        x = np.transpose(x, (0, 2, 3, 1)).copy()
+    x, w, b = jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)
+    args = ((2, 2), (1, 1), 1)
+
+    def run(s):
+        y = NN.conv2d(x, w, b, *args, layout=layout, strategy=s)
+        f = lambda x_, w_: jnp.sum(
+            NN.conv2d(x_, w_, b, *args, layout=layout, strategy=s) ** 2)
+        dx, dw = jax.grad(f, argnums=(0, 1))(x, w)
+        return map(np.asarray, (y, dx, dw))
+
+    for got, want in zip(run(strategy), run("direct")):
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_strategy_inapplicable_falls_back(rng_np):
+    """Grouped conv: im2col/s2d cannot lower it — conv2d silently takes
+    direct, and the candidate filter never offers them."""
+    x = jnp.asarray(rng_np.randn(1, 4, 8, 8).astype(np.float32))
+    w = jnp.asarray(rng_np.randn(8, 2, 3, 3).astype(np.float32))
+    want = NN.conv2d(x, w, None, (1, 1), (1, 1), 2, strategy="direct")
+    for s in ("im2col", "s2d"):
+        got = NN.conv2d(x, w, None, (1, 1), (1, 1), 2, strategy=s)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert not NN.conv_strategy_applicable(s, x, w, (1, 1), 2, "NCHW")
+
+
+def test_conv2d_rejects_unresolved_auto(rng_np):
+    x = jnp.zeros((1, 3, 8, 8), jnp.float32)
+    w = jnp.zeros((4, 3, 3, 3), jnp.float32)
+    with pytest.raises(ValueError, match="auto"):
+        NN.conv2d(x, w, None, (1, 1), (0, 0), strategy="auto")
+
+
+def test_conv_tune_measures_then_persists(tmp_path):
+    """First resolve measures and writes the tuned store; a fresh memo
+    loads the persisted winner without re-measuring."""
+    from poseidon_tpu.ops import conv_tune
+    conv_tune.clear_memo()
+    kw = dict(c=3, h=9, w=9, kernel=(3, 3), stride=(2, 2), pad=(0, 0),
+              group=1, out_ch=4, layout="NCHW", batch=4,
+              cache_dir=str(tmp_path))
+    doc = conv_tune.resolve("convX", **kw)
+    assert doc["source"] == "measured"
+    assert doc["winner"] in doc["timings_ms"]
+    assert set(doc["timings_ms"]) == {"direct", "im2col", "s2d"}
+    assert doc["winner"] == min(doc["timings_ms"],
+                                key=doc["timings_ms"].get)
+    # memo hit within the process
+    assert conv_tune.resolve("convX", **kw)["source"] == "memo"
+    # fresh process simulation: memo cleared, store answers
+    conv_tune.clear_memo()
+    doc3 = conv_tune.resolve("convX", **kw)
+    assert doc3["source"] == "persisted"
+    assert doc3["winner"] == doc["winner"]
+    conv_tune.clear_memo()
+
+
+def test_conv_tune_single_candidate_skips_measurement(tmp_path):
+    from poseidon_tpu.ops import conv_tune
+    conv_tune.clear_memo()
+    doc = conv_tune.resolve("grouped", c=4, h=8, w=8, kernel=(3, 3),
+                            stride=(1, 1), pad=(1, 1), group=2, out_ch=8,
+                            layout="NCHW", batch=4,
+                            cache_dir=str(tmp_path))
+    assert doc == dict(doc, winner="direct", source="only-candidate")
+    assert doc["timings_ms"] == {}
+    conv_tune.clear_memo()
+
+
+def test_net_conv_strategy_plumbing(tmp_path):
+    """Net-level resolution: a forced strategy lands on every conv layer;
+    "auto" assigns each layer a measured winner and a re-built Net (fresh
+    memo) loads the persisted choices."""
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.ops import conv_tune
+    shapes = zoo.lenet_shapes(4)
+    net = Net(zoo.lenet(with_accuracy=False), "TRAIN", shapes,
+              conv_strategy="im2col")
+    assert set(net.conv_strategy_plan().values()) == {"im2col"}
+    # legacy default: layers carry None (the global conv_s2d policy rules)
+    net0 = Net(zoo.lenet(with_accuracy=False), "TRAIN", shapes)
+    assert set(net0.conv_strategy_plan().values()) == {None}
+    with pytest.raises(ValueError, match="conv_strategy"):
+        Net(zoo.lenet(with_accuracy=False), "TRAIN", shapes,
+            conv_strategy="winograd")
+
+    conv_tune.clear_memo()
+    saved = config.compile_cache_config().cache_dir
+    config.set_compile_cache_config(cache_dir=str(tmp_path))
+    try:
+        net1 = Net(zoo.lenet(with_accuracy=False), "TRAIN", shapes,
+                   conv_strategy="auto")
+        plan = net1.conv_strategy_plan()
+        assert set(plan) == {"conv1", "conv2"}
+        assert all(v in ("direct", "im2col", "s2d")
+                   for v in plan.values())
+        conv_tune.clear_memo()
+        net2 = Net(zoo.lenet(with_accuracy=False), "TRAIN", shapes,
+                   conv_strategy="auto")
+        assert net2.conv_strategy_plan() == plan
+        # the resolved plan actually traces and runs
+        params = net1.init(jax.random.PRNGKey(0))
+        out = net1.apply(params, {
+            "data": jnp.zeros(shapes["data"], jnp.float32),
+            "label": jnp.zeros(shapes["label"], jnp.int32)},
+            rng=jax.random.PRNGKey(1))
+        assert np.isfinite(float(out.loss))
+    finally:
+        config.set_compile_cache_config(cache_dir=saved)
+        conv_tune.clear_memo()
+
+
+# --------------------------------------------------------------------------- #
+# the documented --bf16 path: loss-trajectory guardrail
+# --------------------------------------------------------------------------- #
+
+def _train_lenet_losses(rng_np, iters):
+    """LeNet overfitting a fixed 4-batch cycle (random labels memorize
+    reliably at this lr; fresh batches every step would just bounce)."""
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import (CommConfig, build_train_step,
+                                       init_train_state, make_mesh)
+    from poseidon_tpu.proto.messages import SolverParameter
+    batch_n = 16
+    net = Net(zoo.lenet(with_accuracy=False), "TRAIN",
+              zoo.lenet_shapes(batch_n // N_DEV))
+    sp = SolverParameter(base_lr=0.005, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0005)
+    cc = CommConfig()
+    ts = build_train_step(net, sp, make_mesh(), cc, donate=False)
+    params = net.init(jax.random.PRNGKey(0))
+    state = init_train_state(params, cc, N_DEV)
+    data = rng_np.randn(4, batch_n, 1, 28, 28).astype(np.float32)
+    labels = rng_np.randint(0, 10, size=(4, batch_n))
+    losses = []
+    for i in range(iters):
+        batch = {"data": jnp.asarray(data[i % 4]),
+                 "label": jnp.asarray(labels[i % 4])}
+        params, state, m = ts.step(params, state, batch,
+                                   jax.random.fold_in(jax.random.PRNGKey(1),
+                                                      i))
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_bf16_lenet_smoke_within_documented_tolerance():
+    """The --bf16 acceptance guardrail: identical data/seeds, f32 vs the
+    bf16 perf policy; the end-of-smoke loss level must sit inside the
+    documented numeric.BF16_SMOKE_* band. Catches any kernel that starts
+    accumulating below f32 where it must not."""
+    from poseidon_tpu.numeric import (BF16_SMOKE_ATOL, BF16_SMOKE_ITERS,
+                                      BF16_SMOKE_RTOL)
+    f32 = _train_lenet_losses(np.random.RandomState(7), BF16_SMOKE_ITERS)
+    with policy_scope(compute_dtype=jnp.bfloat16, conv_s2d=True):
+        bf16 = _train_lenet_losses(np.random.RandomState(7),
+                                   BF16_SMOKE_ITERS)
+    assert all(np.isfinite(bf16)), "bf16 run diverged"
+    f32_tail = float(np.mean(f32[-5:]))
+    bf16_tail = float(np.mean(bf16[-5:]))
+    tol = BF16_SMOKE_RTOL * abs(f32_tail) + BF16_SMOKE_ATOL
+    assert abs(bf16_tail - f32_tail) <= tol, (
+        f"bf16 tail loss {bf16_tail:.4f} drifted beyond the documented "
+        f"band from f32 {f32_tail:.4f} (tol {tol:.4f})")
+    # and training actually made progress in both arms
+    assert f32_tail < float(np.mean(f32[:3]))
+    assert bf16_tail < float(np.mean(bf16[:3]))

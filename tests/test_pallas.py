@@ -60,6 +60,31 @@ def test_flash_attention_grad_under_jit_and_vmapless_batch(qkv):
         assert np.isfinite(np.asarray(g)).all()
 
 
+def test_pick_block_non_power_of_two_lengths():
+    """Non-power-of-two sequence lengths must tile with the largest
+    ALIGNED block that divides them (Mosaic needs the second-minor block
+    dim to be a multiple of the 8-row f32 sublane tile), not fall back to
+    None — s=48 tiles at 16, s=136 at 8; only unaligned lengths refuse."""
+    from poseidon_tpu.ops.pallas_kernels import pick_block
+    assert pick_block(1024) == 128
+    assert pick_block(384) == 128     # 3 * 128
+    assert pick_block(96) == 32
+    assert pick_block(48) == 16       # used to fall back to None
+    assert pick_block(136) == 8       # 17 * 8
+    assert pick_block(24) == 8
+    assert pick_block(100) is None    # 4 mod 8: no aligned block exists
+    assert pick_block(7) is None
+    # the flash kernel really runs at the small-block rungs
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.randn(1, 2, 48, 16).astype(np.float32))
+    from poseidon_tpu.ops.pallas_kernels import flash_attention
+    got = flash_attention(q, q, q, True, None, pick_block(48),
+                          pick_block(48), interpret=True)
+    want = attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_maybe_flash_routing(qkv):
     """Off-TPU, routing must use the dense op (interpret-mode Pallas would
     be an emulation slowdown) — bit-identical to attention(). On a real TPU
