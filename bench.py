@@ -1650,6 +1650,96 @@ def mesh_main(argv: list) -> None:
         sys.exit(1)
 
 
+# --------------------------------------------------------------------------- #
+# comms mode: `python bench.py comms` — dense vs managed over a throttled link
+# --------------------------------------------------------------------------- #
+
+def comms_main(argv: list | None = None) -> None:
+    """A/B the async-SSP DCN tier's managed communication (SSPAggr) over a
+    deterministically throttled link: the same clock/push/gate/refresh
+    cadence runs once with dense flushes and once with a bandwidth budget
+    matching the link (magnitude-prioritized partial pushes, residual
+    full-flush at every staleness boundary), through a FaultProxy
+    ``throttle`` rule. Emits ``managed_comm_speedup`` (dense wall /
+    managed wall, >1 = managed wins) and ``managed_comm_deferred_fraction``
+    BENCH lines. Pure socket tier — no accelerator involved, so the run is
+    labeled a CPU proxy either way; the TPU-side re-measure (real DCN, the
+    cross-slice links of ROADMAP item 4) is queued for the tunnel."""
+    import argparse
+
+    import numpy as np
+
+    from poseidon_tpu.parallel.async_ssp import AsyncSSPClient, ParamService
+    from poseidon_tpu.runtime.faults import FaultProxy, FaultRule
+
+    ap = argparse.ArgumentParser(prog="bench.py comms")
+    ap.add_argument("--param_kb", type=int, default=1024,
+                    help="dense flush size in KiB (default 1 MiB)")
+    ap.add_argument("--link_mbps", type=float, default=16.0,
+                    help="throttled link rate in Mbit/s (both directions)")
+    ap.add_argument("--clocks", type=int, default=6)
+    ap.add_argument("--staleness", type=int, default=2)
+    ap.add_argument("--priority_frac", type=float, default=0.05)
+    args = ap.parse_args(argv)
+
+    side = int(max(16, (args.param_kb * 256) ** 0.5))  # side^2 f32 = kb
+    rate_bps = args.link_mbps * 1e6 / 8.0
+    params = {"fc": {"w": np.zeros((side, side), np.float32)}}
+
+    def run_arm(managed: bool) -> dict:
+        svc = ParamService(params, n_workers=1)
+        proxy = FaultProxy(("127.0.0.1", svc.port))
+        proxy.add_rule(FaultRule(action="throttle", rate_bps=rate_bps,
+                                 burst_bytes=int(rate_bps / 8)))
+        cli = AsyncSSPClient(
+            0, proxy.addr, args.staleness, n_workers=1,
+            budget_mbps=args.link_mbps if managed else None,
+            priority_frac=args.priority_frac)
+        rng = np.random.RandomState(17)
+        t0 = time.monotonic()
+        try:
+            for c in range(args.clocks):
+                delta = {"fc": {"w": rng.randn(side, side)
+                                .astype(np.float32) * 1e-3}}
+                cli.push(delta)
+                cli.gate(c + 1)
+                if (c + 1) % (args.staleness + 1) == 0:
+                    cli.refresh()       # anchor pull at the SSP boundary
+            cli.mark_done()
+            wall = time.monotonic() - t0
+            return {"wall_s": round(wall, 3),
+                    "final_anchor_sum": float(svc.anchor["fc"]["w"].sum()),
+                    **cli.comm_counters()}
+        finally:
+            cli.close()
+            proxy.close()
+            svc.close()
+
+    dense = run_arm(managed=False)
+    managed = run_arm(managed=True)
+    speedup = (dense["wall_s"] / managed["wall_s"]
+               if managed["wall_s"] else 0.0)
+    cfg = {
+        "cpu_proxy": True,  # socket tier on loopback; TPU DCN re-measure
+        #                     queued for the tunnel (ROADMAP item 4 links)
+        "link_mbps": args.link_mbps,
+        "param_kb": args.param_kb,
+        "clocks": args.clocks,
+        "staleness": args.staleness,
+        "priority_frac": args.priority_frac,
+    }
+    emit({"metric": "managed_comm_speedup", "value": round(speedup, 3),
+          "unit": "x", "vs_baseline": round(speedup, 3), **cfg,
+          "dense": dense, "managed": managed})
+    # the companion line carries the SAME run parameters so round-over-
+    # round tracking can tell configurations apart; the fraction is
+    # informational (its "good" direction depends on the budget config),
+    # so vs_baseline rides the speedup the deferral bought
+    emit({"metric": "managed_comm_deferred_fraction",
+          "value": round(managed.get("deferred_fraction", 0.0), 4),
+          "unit": "fraction", "vs_baseline": round(speedup, 3), **cfg})
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
@@ -1657,5 +1747,7 @@ if __name__ == "__main__":
         attribution_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "mesh":
         mesh_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "comms":
+        comms_main(sys.argv[2:])
     else:
         main()

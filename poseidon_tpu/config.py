@@ -145,6 +145,43 @@ def set_fault_config(**kwargs) -> None:
 
 
 @dataclass
+class ManagedCommConfig:
+    """Managed-communication policy for the async-SSP DCN tier (SSPAggr:
+    bandwidth-budgeted, magnitude-prioritized pushes,
+    parallel/async_ssp.py).
+
+    With a finite budget the client meters ACTUAL frame bytes on both
+    channels through a token bucket; when a dense flush would overdraw
+    it, only the top ``priority_frac`` of the delta by |value| ships now
+    (TOPK index+value wire form) and the exact complement rides a local
+    residual, force-flushed at every staleness+1 clock boundary — the
+    SSP bound is preserved exactly via durable-clock gating. Budget
+    <= 0 = unlimited: byte-for-byte the dense path."""
+
+    # per-link bandwidth budget in Mbit/s (<= 0 disables managed mode)
+    budget_mbps: float = 0.0
+    # fraction of delta entries a budget-tight push ships, by |value|
+    priority_frac: float = 0.1
+    # adaptive cadence: back off payload frequency under congestion
+    # (queue depth / bucket deficit), recover as the link drains
+    adaptive: bool = False
+
+
+_managed_comm = ManagedCommConfig()
+
+
+def managed_comm_config() -> ManagedCommConfig:
+    return _managed_comm
+
+
+def set_managed_comm_config(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_managed_comm, k):
+            raise AttributeError(k)
+        setattr(_managed_comm, k, v)
+
+
+@dataclass
 class PipelineConfig:
     """Step-pipeline policy for the training loop (runtime/engine.py).
 

@@ -88,6 +88,39 @@ capacity grows and shrinks, so this tier's member set does too):
   ``refresh`` raises it into the training loop — a run never silently
   drops oplogs behind a dead thread.
 
+Managed communication (SSPAggr/SSPPush — the paper's third signature
+mechanism, re-homed onto this tier's wire):
+
+- per-link bandwidth budget: a token bucket (``TokenBucket``) refilled at
+  ``budget_mbps`` and charged with the ACTUAL frame bytes of every RPC on
+  BOTH channels (push and pull) — the ``client_bandwidth_mbps`` /
+  TransTimeEstimate accounting, measured instead of modeled.
+- magnitude-prioritized PARTIAL pushes: when the bucket cannot cover a
+  dense flush, the client sends only the top ``priority_frac`` of the
+  delta by |value| (the server's RelativeMagnitude UpdateSortPolicy),
+  encoded as the TOPK index+value wire form (``("topk", idx, vals)``
+  leaves — the same logical bytes ``runtime/comm_stats.py`` meters for
+  the compiled TOPK tier), and accumulates the EXACT complement locally
+  (``residual``: sent + residual == delta + carried-residual, elementwise
+  bitwise — nothing lost, only delayed).
+- bounded staleness preserved EXACTLY: every ``staleness + 1`` clocks
+  (the SSP window boundary) the flush is forced FULL — delta plus the
+  whole residual — and the service tracks a per-worker DURABLE clock
+  (last fully-flushed clock) next to the raw clock. Read gates run over
+  the durable vector: a reader at clock r proceeds only when every peer's
+  durable clock >= r - s - 1, i.e. when everything the SSP contract
+  promises it is actually IN the anchor. Dense pushes are always full
+  (durable == clock), so the dense path's gate behavior is unchanged;
+  partial pushes trade gate wait (bounded by one window) for wire bytes —
+  graceful degradation, never a widened bound.
+- adaptive cadence: the sender measures per-RPC goodput and queue depth;
+  under congestion (bucket in deficit, or flushes piling up behind a slow
+  link) it backs off the PAYLOAD cadence — intermediate clocks ship as
+  empty partial ticks (~100 B, preserving "a clock == sync_every
+  iterations" and liveness) and the accumulated delta rides the next
+  boundary/recovered flush. Recovery halves the backoff as the link
+  drains. ``cadence_backoffs`` counts escalations.
+
 Wire format: length-prefixed pickles of numpy pytrees over TCP on the
 launcher's control network. A malformed or truncated frame never kills
 the service: the offending connection is logged and dropped
@@ -116,15 +149,16 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..proto.wire import (AuthError, FrameError, client_handshake,
-                          recv_frame as _recv_msg, send_frame as _send_msg,
-                          server_handshake)
+                          recv_frame as _recv_msg,
+                          recv_frame_sized as _recv_msg_sized,
+                          send_frame as _send_msg, server_handshake)
 # span instrumentation for the tier's wait points (push enqueue, anchor
 # pulls, SSP gate, elastic admit); jax-free like everything else here, and
 # a no-op until the engine enables the recorder under --trace_out
 from ..runtime.spans import recorder as _spans
 
-__all__ = ["ParamService", "AsyncSSPClient", "run_async_ssp_worker",
-           "FrameError", "AuthError"]
+__all__ = ["ParamService", "AsyncSSPClient", "TokenBucket",
+           "run_async_ssp_worker", "split_topk", "FrameError", "AuthError"]
 
 AUTH_TOKEN_ENV = "POSEIDON_ASYNC_TOKEN"
 
@@ -164,6 +198,137 @@ def _tree_sub(a: Dict, b: Dict) -> Dict:
 
 def _tree_copy(a: Dict) -> Dict:
     return {l: {p: np.array(v) for p, v in ps.items()} for l, ps in a.items()}
+
+
+# --------------------------------------------------------------------------- #
+# managed communication: sparse wire form, budget, prioritized selection
+# --------------------------------------------------------------------------- #
+# A partial push encodes each leaf as ("topk", idx, vals): flat int indices
+# + float32 values of the magnitude-selected entries — the same logical
+# index+value bytes the compiled TOPK tier's accounting meters
+# (runtime/comm_stats.py: k * (4B index + value bytes)). Dense leaves stay
+# plain ndarrays, so a full flush is byte-for-byte the pre-managed wire.
+
+def _is_sparse(v) -> bool:
+    return isinstance(v, tuple) and len(v) == 3 and v[0] == "topk"
+
+
+def _tree_add_any(a: Dict, b: Dict) -> None:
+    """In-place a += b where b's leaves are dense ndarrays OR sparse
+    ("topk", idx, vals) tuples. Top-k indices are unique by construction,
+    and ``.flat`` fancy assignment writes through regardless of layout."""
+    for l, ps in b.items():
+        for p, v in ps.items():
+            if _is_sparse(v):
+                _, idx, vals = v
+                a[l][p].flat[idx] += vals
+            else:
+                a[l][p] += v
+
+
+def _tree_copy_any(a: Dict) -> Dict:
+    out: Dict = {}
+    for l, ps in a.items():
+        out[l] = {}
+        for p, v in ps.items():
+            out[l][p] = (("topk", np.array(v[1]), np.array(v[2]))
+                         if _is_sparse(v) else np.array(v))
+    return out
+
+
+def _tree_nbytes(a: Dict) -> int:
+    """Payload bytes a DENSE flush of this tree would put on the wire
+    (array bytes only — pickle framing overhead is charged at send time
+    from the actual frame size)."""
+    return sum(v.nbytes for ps in a.values() for v in ps.values())
+
+
+def _tree_elems(a: Dict) -> int:
+    return sum(int(v.size) for ps in a.values() for v in ps.values())
+
+
+def split_topk(tree: Dict, frac: float):
+    """Magnitude-prioritized split of an update tree under a budget.
+
+    Returns ``(sent, residual, n_sent, n_total)``: ``sent`` holds the top
+    ``frac`` of entries by |value| across the WHOLE tree (global ranking —
+    the bytes the link can carry go to the most important coordinates
+    first, the SSPAggr rule), encoded sparse; ``residual`` is the EXACT
+    elementwise complement (selected coordinates 0, everything else the
+    original value — sent + residual reassembles the input bitwise, so
+    nothing is ever lost, only delayed)."""
+    leaves = [(l, p, v) for l, ps in tree.items() for p, v in ps.items()]
+    n_total = sum(int(v.size) for _, _, v in leaves)
+    if n_total == 0:
+        return {}, {}, 0, 0
+    k = max(1, int(round(n_total * frac)))
+    if k >= n_total:
+        return _tree_copy(tree), {l: {p: np.zeros_like(v)
+                                      for p, v in ps.items()}
+                                  for l, ps in tree.items()}, n_total, n_total
+    flat = np.concatenate([np.asarray(v, np.float32).ravel()
+                           for _, _, v in leaves])
+    # top-k by magnitude; tie order among equal magnitudes is whatever
+    # argpartition picks — ANY selection preserves the boundary invariant
+    # (sent + residual == input exactly), so ties need no canonical order
+    top = np.argpartition(np.abs(flat), n_total - k)[n_total - k:]
+    mask = np.zeros(n_total, bool)
+    mask[top] = True
+    sent: Dict = {}
+    residual: Dict = {}
+    off = 0
+    for l, p, v in leaves:
+        n = int(v.size)
+        m = mask[off:off + n]
+        vals = flat[off:off + n]
+        idx = np.flatnonzero(m)
+        dt = np.int32 if n <= np.iinfo(np.int32).max else np.int64
+        sent.setdefault(l, {})[p] = ("topk", idx.astype(dt),
+                                     vals[idx].astype(np.float32))
+        res = np.where(m, np.float32(0.0), vals).reshape(v.shape)
+        residual.setdefault(l, {})[p] = res
+        off += n
+    return sent, residual, k, n_total
+
+
+class TokenBucket:
+    """Byte-budget token bucket for the managed-communication link.
+
+    ``rate_bps`` tokens (bytes) per second refill, capped at ``burst``.
+    ``consume`` ACCOUNTS traffic (it may drive the balance negative —
+    accounting never blocks the data plane; correctness traffic like
+    gates, heartbeats and forced boundary flushes always goes through);
+    the SEND policy reads ``available()`` to choose dense vs partial.
+    ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, rate_bps: float, burst_bytes: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate_bps)
+        # default burst: one second of budget, floor 64 KiB so small
+        # control frames never starve at tiny configured rates
+        self.burst = float(burst_bytes if burst_bytes is not None
+                           else max(self.rate, 65536.0))
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        if now > self._last:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def available(self) -> float:
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+    def consume(self, nbytes: float) -> None:
+        with self._lock:
+            self._refill_locked()
+            self._tokens -= float(nbytes)
 
 
 def _fault_defaults(heartbeat_s, liveness_timeout_s, reconnect_deadline_s,
@@ -240,6 +405,13 @@ class ParamService:
             self.gsum = _tree_copy(zeros)    # total raw gradient applied
             self.gbase = {w: _tree_copy(zeros) for w in range(n_workers)}
         self.clocks = {w: -1 for w in range(n_workers)}  # applied clocks
+        # managed communication: the DURABLE clock — the last clock whose
+        # flush was FULL (dense, or partial-mode boundary flush carrying
+        # the whole residual). Everything the worker produced through this
+        # clock is IN the anchor; read gates run over this vector, so the
+        # SSP bound holds exactly even when intermediate pushes defer
+        # bytes. Dense pushes are always full: durable == clocks there.
+        self.durable = {w: -1 for w in range(n_workers)}
         self.n_workers = n_workers
         # elastic membership: the ACTIVE worker set. Starts as the launch
         # roster; `admit` grows it mid-run (rendezvous at the anchor
@@ -335,6 +507,7 @@ class ParamService:
         frozen clock must not wedge a straggler's last gate, and a dead
         one must not deadlock survivors)."""
         return {"clocks": dict(self.clocks),
+                "durable": dict(self.durable),
                 "members": sorted(self.members),
                 "failed": sorted(self.failed_workers),
                 "done": sorted(self.done_workers)}
@@ -377,6 +550,9 @@ class ParamService:
         self.failed_workers.discard(w)
         self.done_workers.discard(w)
         self.clocks[w] = join
+        # a joiner owes nothing before its join clock: durable starts
+        # there too, so peers' gates never wait on pre-join history
+        self.durable[w] = max(self.durable.get(w, -1), join)
         self.applied_seq[w] = max(self.applied_seq.get(w, -1), join)
         self.last_seen[w] = time.time()
         if self.server_logic == "adarevision":
@@ -442,12 +618,30 @@ class ParamService:
                             dup = seq <= self.applied_seq.get(w, -1)
                             if not dup:
                                 if self.server_logic == "adarevision":
+                                    # partial (sparse) pushes are refused
+                                    # client-side for adarevision — the
+                                    # backlog re-base needs dense updates
                                     self._apply_adarevision(w, msg["delta"])
                                 else:
-                                    _tree_add(self.anchor, msg["delta"])
+                                    # residual-aware apply: sparse leaves
+                                    # add at their indices, dense leaves
+                                    # add whole — composing additively, so
+                                    # the exactly-once seq dedup covers
+                                    # partial pushes with zero new cases
+                                    # (a replayed partial is the SAME
+                                    # payload, acked without re-apply)
+                                    _tree_add_any(self.anchor, msg["delta"])
                                 self.applied_seq[w] = seq
                                 self.clocks[w] = max(
                                     self.clocks.get(w, -1), msg["clock"])
+                                if msg.get("full", True):
+                                    # full flush: everything through this
+                                    # clock (delta + carried residual) is
+                                    # now in the anchor — gates may admit
+                                    # readers against it
+                                    self.durable[w] = max(
+                                        self.durable.get(w, -1),
+                                        msg["clock"])
                                 self._version += 1
                                 cs = self._live_clocks()
                                 if cs and all(c >= 0 for c in cs):
@@ -604,7 +798,11 @@ class AsyncSSPClient:
                  reconnect_deadline_s: Optional[float] = None,
                  backoff_base_s: Optional[float] = None,
                  backoff_cap_s: Optional[float] = None,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 budget_mbps: Optional[float] = None,
+                 priority_frac: float = 0.1,
+                 adaptive: bool = False,
+                 bucket_clock: Callable[[], float] = time.monotonic):
         self.worker = worker
         self.auth_token = _env_auth_token(auth_token)
         self.n_workers = n_workers if n_workers else worker + 1
@@ -612,6 +810,39 @@ class AsyncSSPClient:
         self.server_logic = server_logic
         self.init_step = init_step
         self._addr = addr
+        # managed communication (SSPAggr): None/<=0 budget = unlimited —
+        # every push takes EXACTLY the dense path (no residual machinery,
+        # no behavior change). A finite budget enables magnitude-
+        # prioritized partial pushes under pressure, with the residual
+        # carried locally and force-flushed at every SSP window boundary.
+        if budget_mbps is not None and budget_mbps > 0:
+            if server_logic == "adarevision":
+                raise ValueError(
+                    "managed communication (budget_mbps) does not compose "
+                    "with server_logic='adarevision': the server's backlog "
+                    "re-base needs dense raw-gradient pushes")
+            self.budget: Optional[TokenBucket] = TokenBucket(
+                budget_mbps * 1e6 / 8.0, clock=bucket_clock)
+        else:
+            self.budget = None
+        self.priority_frac = min(1.0, max(1e-6, priority_frac))
+        self.adaptive = adaptive
+        self._residual: Optional[Dict] = None  # train-thread only
+        # cadence backoff factor (1 = every window ships its delta); the
+        # sender thread escalates/decays it, push() reads it — both under
+        # _stats_lock (shared with the reconnect counter)
+        self._backoff = 1
+        self._backoff_cap = 8
+        self.cadence_backoffs = 0
+        # per-link traffic counters (actual frame bytes, both channels),
+        # written by sender AND train threads — _stats_lock discipline
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.partial_pushes = 0
+        self.full_pushes = 0
+        self.deferred_elems = 0
+        self.pushed_elems = 0
+        self._goodput_mbps = 0.0  # EWMA of per-RPC goodput (both dirs)
         (self.heartbeat_s, _, self.reconnect_deadline_s,
          self.backoff_base_s, self.backoff_cap_s) = _fault_defaults(
             heartbeat_s, None, reconnect_deadline_s,
@@ -633,9 +864,13 @@ class AsyncSSPClient:
         self._push_lock = threading.Lock()
         self._pull_lock = threading.Lock()
         self._q: "queue.Queue" = queue.Queue()
-        self._pending: List[Tuple[int, Dict]] = []  # un-applied own updates
+        # un-applied own updates: (clock, payload-as-sent, full) — the
+        # replay oplog holds exactly what went on the wire (sparse or
+        # dense) so a reconnect replays byte-identical flushes
+        self._pending: List[Tuple[int, Dict, bool]] = []
         self._pending_lock = threading.Lock()
         self.clocks: Dict[int, int] = {}
+        self.durable: Dict[int, int] = {}  # peers' fully-flushed clocks
         self.failed: set = set()   # peers the service declared dead
         self.done: set = set()     # peers that finished their run
         # the CURRENT member set, replaced by every clock-bearing reply —
@@ -687,6 +922,30 @@ class AsyncSSPClient:
             cap=self.backoff_cap_s, rng=self._rng,
             retry_on=(OSError, EOFError), should_stop=self._stop.is_set)
 
+    def _rpc(self, sock: socket.socket, msg: Dict) -> Dict:
+        """One request/reply exchange with bandwidth accounting: the
+        ACTUAL frame bytes of both directions are charged to the token
+        bucket (push and pull paths alike) and folded into the per-link
+        counters + goodput EWMA. Accounting never blocks — the budget
+        shapes the SEND POLICY (dense vs partial), not the socket."""
+        t0 = time.monotonic()
+        sent = _send_msg(sock, msg)
+        reply, got = _recv_msg_sized(sock)
+        dt = max(1e-9, time.monotonic() - t0)
+        if self.budget is not None:
+            self.budget.consume(sent + got)
+        with self._stats_lock:
+            self.bytes_sent += sent
+            self.bytes_recv += got
+            # goodput of this RPC in Mbit/s, smoothed; tiny control frames
+            # measure link round-trip more than bandwidth, so only frames
+            # big enough to be payload-dominated move the estimate
+            if sent + got >= 4096:
+                mbps = 8.0 * (sent + got) / dt / 1e6
+                self._goodput_mbps = (0.8 * self._goodput_mbps + 0.2 * mbps
+                                      if self._goodput_mbps else mbps)
+        return reply
+
     def _reconnect_channel(self, lock: threading.Lock, sock_attr: str,
                            body: Callable[[socket.socket], Dict]) -> Dict:
         """Shared recovery envelope for both channels: redial with the
@@ -737,17 +996,20 @@ class AsyncSSPClient:
         and rides the replay; anything else is re-sent afterwards."""
         def replay(sk: socket.socket) -> Dict:
             with self._pending_lock:
-                backlog = [(c, d) for c, d in self._pending
+                backlog = [(c, d, f) for c, d, f in self._pending
                            if c > self._acked_clock]
             ack: Optional[Dict] = None
-            for c, d in backlog:
-                _send_msg(sk, {"kind": "push", "worker": self.worker,
-                               "clock": c, "seq": c, "delta": d})
-                ack = _recv_msg(sk)
+            for c, d, f in backlog:
+                # the pending oplog holds the PAYLOAD AS SENT (sparse or
+                # dense) plus its full-flush flag, so a replayed partial
+                # is byte-identical to the original and the seq dedup
+                # stays exactly-once with no residual special cases
+                ack = self._rpc(sk, {"kind": "push", "worker": self.worker,
+                                     "clock": c, "seq": c, "delta": d,
+                                     "full": f})
                 self._acked_clock = max(self._acked_clock, c)
             if msg is not None and msg.get("kind") != "push":
-                _send_msg(sk, msg)
-                ack = _recv_msg(sk)
+                ack = self._rpc(sk, msg)
             return ack if ack is not None else {"ok": True}
 
         ack = self._reconnect_channel(self._push_lock, "_push_sock", replay)
@@ -760,8 +1022,7 @@ class AsyncSSPClient:
         dead socket by reconnect + replay."""
         try:
             with self._push_lock:
-                _send_msg(self._push_sock, msg)
-                ack = _recv_msg(self._push_sock)
+                ack = self._rpc(self._push_sock, msg)
         except (OSError, EOFError) as e:
             if self._stop.is_set():
                 raise
@@ -776,6 +1037,12 @@ class AsyncSSPClient:
         """Adopt a reply's membership snapshot (clock table, member list,
         failed/done sets) — the client's entire view of the fleet."""
         self.clocks = resp["clocks"]
+        # durable clocks gate managed-mode reads; a service without the
+        # field (never the in-repo one) degenerates to the raw clocks.
+        # Both channels absorb views (sender acks, train-thread pulls)
+        # and the gate reads concurrently — lock the swap pair
+        with self._stats_lock:
+            self.durable = resp.get("durable", resp["clocks"])
         self.failed = set(resp.get("failed", ()))
         if "members" in resp:
             self.members = set(resp["members"])
@@ -788,8 +1055,7 @@ class AsyncSSPClient:
         idempotent (pull/clocks/done), so a blind retry is safe."""
         try:
             with self._pull_lock:
-                _send_msg(self._pull_sock, msg)
-                return _recv_msg(self._pull_sock)
+                return self._rpc(self._pull_sock, msg)
         except (OSError, EOFError) as e:
             if self._stop.is_set():
                 raise
@@ -797,8 +1063,7 @@ class AsyncSSPClient:
                  f"({type(e).__name__}: {e}); reconnecting")
 
         def resend(sk: socket.socket) -> Dict:
-            _send_msg(sk, msg)
-            return _recv_msg(sk)
+            return self._rpc(sk, msg)
 
         return self._reconnect_channel(self._pull_lock, "_pull_sock", resend)
 
@@ -813,14 +1078,15 @@ class AsyncSSPClient:
                 item = None
             try:
                 if item is not None:
-                    clock, delta = item
+                    clock, delta, full = item
                     if clock > self._acked_clock:
                         # (a recovery replay may already have landed it)
                         self._push_rpc({"kind": "push",
                                         "worker": self.worker,
                                         "clock": clock, "seq": clock,
-                                        "delta": delta})
+                                        "delta": delta, "full": full})
                         self._acked_clock = max(self._acked_clock, clock)
+                    self._update_cadence()
                     last_hb = time.time()
                 elif self.heartbeat_s > 0 and \
                         time.time() - last_hb >= self.heartbeat_s:
@@ -844,15 +1110,129 @@ class AsyncSSPClient:
                 f"{self._acked_clock + 1} on were never applied"
             ) from self.dead
 
-    def push(self, delta: Dict) -> int:
+    # ---- managed send policy -------------------------------------------- #
+    def _is_boundary(self, clock: int) -> bool:
+        """SSP window boundaries — the clocks whose flush MUST be full so
+        the residual age never exceeds the staleness bound. Every s+1
+        clocks; at s=0 every clock is a boundary (managed degenerates to
+        dense, as it must: zero staleness leaves no room to defer)."""
+        return (clock + 1) % (self.staleness + 1) == 0
+
+    def _has_residual(self) -> bool:
+        # train-thread-only state, like _residual itself (push/refresh/
+        # join/leave all run on the training thread; the sender thread
+        # ships pre-built payloads and never sees the residual)
+        r = self._residual
+        return r is not None and any(np.any(v) for ps in r.values()
+                                     for v in ps.values())
+
+    def _update_cadence(self) -> None:
+        """Sender-thread congestion control (adaptive cadence): escalate
+        the payload backoff when the bucket is in deficit or flushes pile
+        up behind a slow link; decay it as the link recovers. The factor
+        only defers PAYLOAD (intermediate clocks ship as empty partial
+        ticks) — clock cadence and liveness are untouched."""
+        if not self.adaptive:
+            return
+        congested = self._q.qsize() >= 2 or (
+            self.budget is not None and self.budget.available() < 0)
+        with self._stats_lock:
+            if congested and self._backoff < self._backoff_cap:
+                self._backoff = min(self._backoff * 2, self._backoff_cap)
+                self.cadence_backoffs += 1
+            elif not congested and self._backoff > 1:
+                self._backoff -= 1
+
+    @property
+    def cadence_factor(self) -> int:
+        with self._stats_lock:
+            return self._backoff
+
+    def _managed_payload(self, delta: Dict, clock: int,
+                         force_full: bool) -> Tuple[Dict, bool]:
+        """Decide what this clock's flush puts on the wire. Returns
+        (payload, full): ``full`` means everything through ``clock`` —
+        delta plus any carried residual — is in the payload (the durable-
+        clock contract). Unlimited budget short-circuits to exactly the
+        dense path. Caller is the train thread (push); the residual is
+        touched only here and in refresh/join, same thread."""
+        if self.budget is None and self._residual is None:
+            # today's dense path, byte for byte (counters only)
+            if delta:
+                with self._stats_lock:
+                    self.full_pushes += 1
+                    self.pushed_elems += _tree_elems(delta)
+            return delta, True
+        # fold the carried residual into this clock's update (one
+        # elementwise add; sent + new residual reassembles it exactly)
+        if self._residual is not None:
+            flat = _tree_copy(self._residual)
+            if delta:
+                _tree_add(flat, delta)
+        else:
+            flat = delta
+        n = _tree_elems(flat)
+        if n == 0:
+            return {}, True  # pure clock tick, nothing deferred
+        full = (force_full or self.budget is None
+                or self._is_boundary(clock))
+        if not full:
+            with self._stats_lock:
+                deferring = self._backoff > 1
+            if deferring:
+                # cadence backoff: park the whole update in the residual,
+                # ship a ~100 B clock tick; the next boundary (or a
+                # recovered link) carries it
+                self._residual = flat
+                with self._stats_lock:
+                    self.partial_pushes += 1
+                    self.deferred_elems += n
+                    self.pushed_elems += n
+                return {}, False
+            if self.budget.available() >= _tree_nbytes(flat):
+                full = True  # budget comfortable: dense flush
+        if full:
+            self._residual = None
+            with self._stats_lock:
+                self.full_pushes += 1
+                self.pushed_elems += n
+            return flat, True
+        # budget tight: magnitude-prioritized partial push
+        sent, residual, k, n = split_topk(flat, self.priority_frac)
+        if k >= n:
+            # the fraction selects EVERYTHING (priority_frac=1.0, or a
+            # tree so small the 1-entry floor covers it): that is a full
+            # flush and must be labeled one — the durable clock advances
+            # and no all-zero residual is carried around
+            self._residual = None
+            with self._stats_lock:
+                self.full_pushes += 1
+                self.pushed_elems += n
+            return flat, True
+        self._residual = residual
+        with self._stats_lock:
+            self.partial_pushes += 1
+            self.deferred_elems += n - k
+            self.pushed_elems += n
+        return sent, False
+
+    def push(self, delta: Dict, force_full: bool = False) -> int:
         """Flush one clock's accumulated update. Returns the new clock.
-        NEVER blocks on the network — the sender thread owns the socket."""
+        NEVER blocks on the network — the sender thread owns the socket.
+        Under a finite budget the payload may be a magnitude-prioritized
+        partial push (or an empty tick under cadence backoff); the exact
+        complement rides the local residual and is force-flushed at every
+        SSP window boundary, ``force_full=True``, leave() and
+        mark_done()."""
         self._check_alive()
         with _spans.span("async_push", "async", {"worker": self.worker}):
             self.clock += 1
+            payload, full = self._managed_payload(delta, self.clock,
+                                                  force_full)
             with self._pending_lock:
-                self._pending.append((self.clock, _tree_copy(delta)))
-            self._q.put((self.clock, delta))
+                self._pending.append((self.clock, _tree_copy_any(payload),
+                                      full))
+            self._q.put((self.clock, payload, full))
             return self.clock
 
     def _drain(self, timeout_s: Optional[float] = None) -> None:
@@ -884,8 +1264,21 @@ class AsyncSSPClient:
         FAILED and DONE peers are excluded: a dead or departed worker
         must not deadlock the survivors' gates, and a finished worker's
         frozen clock must not wedge a straggler's last window
-        (elasticity; the reference would abort the whole job here)."""
-        others = [self.clocks.get(w, -1) for w in sorted(self.members)
+        (elasticity; the reference would abort the whole job here).
+
+        The vector gated on is the DURABLE clock (last FULLY-flushed
+        clock): under managed communication a peer's raw clock may run
+        ahead of the bytes actually in the anchor, and admitting a read
+        against it would silently widen the SSP bound by the residual
+        age. Dense pushes are always full (durable == raw clock), so the
+        dense path gates exactly as before. No deadlock is possible:
+        boundaries land every s+1 clocks, so a peer at raw clock c always
+        has durable >= c - s — every gate a dense run would pass, a
+        managed run passes within the same window."""
+        with self._stats_lock:
+            durable = self.durable
+        others = [durable.get(w, self.clocks.get(w, -1))
+                  for w in sorted(self.members)
                   if w != self.worker and w not in self.failed
                   and w not in self.done]
         return min(others) if others else self.clock
@@ -911,9 +1304,14 @@ class AsyncSSPClient:
             while self._min_other_clock() < need:
                 self._check_alive()
                 if time.time() - t0 > timeout_s:
+                    with self._stats_lock:
+                        durable = dict(self.durable)
                     raise TimeoutError(
                         f"worker {self.worker} stuck at gate: need clock "
-                        f"{need}, have {self.clocks} (a peer died and "
+                        f"{need}, have durable {durable} (raw "
+                        f"{self.clocks}; a raw clock ahead of its durable "
+                        f"entry = a peer's partial pushes have not "
+                        f"boundary-flushed; all stuck = a peer died and "
                         f"eviction is disabled?)")
                 resp = self._pull_rpc({"kind": "clocks"})
                 self._absorb_view(resp)
@@ -941,8 +1339,9 @@ class AsyncSSPClient:
         applied = self.clocks.get(self.worker, -1)
         cache = snap["anchor"]
         with self._pending_lock:
-            self._pending = [(c, d) for c, d in self._pending if c > applied]
-            for _, d in self._pending:
+            self._pending = [(c, d, f) for c, d, f in self._pending
+                             if c > applied]
+            for _, d, _ in self._pending:
                 if self.server_logic == "adarevision":
                     # pending entries are RAW gradients: preview them at
                     # the client-lr estimate, exactly as the worker loop
@@ -953,7 +1352,13 @@ class AsyncSSPClient:
                             cache[l][pn] = cache[l][pn] - \
                                 self.init_step * gv
                 else:
-                    _tree_add(cache, d)
+                    # pending payloads may be sparse partial pushes
+                    _tree_add_any(cache, d)
+        if self._residual is not None:
+            # read-my-writes covers DEFERRED bytes too: the cache is
+            # anchor + pending-as-sent + local residual, so this worker's
+            # own view never loses the complement a partial push parked
+            _tree_add(cache, self._residual)
         return cache, dict(self.clocks)
 
     def rejoin(self) -> Tuple[Dict, Dict[int, int]]:
@@ -970,6 +1375,7 @@ class AsyncSSPClient:
         applied = self.clocks.get(self.worker, -1)
         self.clock = applied
         self._acked_clock = applied
+        self._residual = None  # a fresh process has no deferred bytes
         with self._pending_lock:
             self._pending = []
         return snap["anchor"], dict(self.clocks)
@@ -991,15 +1397,20 @@ class AsyncSSPClient:
                             self.clocks.get(self.worker, -1)))
         self.clock = join
         self._acked_clock = join
+        self._residual = None
         with self._pending_lock:
             self._pending = []
         return snap["anchor"], dict(self.clocks)
 
     def leave(self) -> None:
-        """Deliberate scale-down: drain every flushed clock (the retire
-        must not overtake a delta still in flight), then retire this
-        worker's slot — survivors' gates stop waiting on it immediately,
-        with no liveness timeout involved."""
+        """Deliberate scale-down: flush any deferred residual (a retiring
+        worker's parked bytes must reach the anchor — bounded loss is the
+        FAILURE model, not the shutdown model), drain every flushed clock
+        (the retire must not overtake a delta still in flight), then
+        retire this worker's slot — survivors' gates stop waiting on it
+        immediately, with no liveness timeout involved."""
+        if self._has_residual():
+            self.push({}, force_full=True)
         self._drain()
         resp = self._pull_rpc({"kind": "retire", "worker": self.worker})
         if isinstance(resp, dict) and "clocks" in resp:
@@ -1007,8 +1418,12 @@ class AsyncSSPClient:
 
     def mark_done(self) -> None:
         """Tell the service this worker's run is complete (not a barrier)."""
-        # every flushed clock must be ACKED first: 'done' must not overtake
-        # the final delta still in flight on the push socket
+        # any deferred residual flushes first (one forced-full clock tick:
+        # a completed run's anchor contribution must be its WHOLE update
+        # stream), then every flushed clock must be ACKED: 'done' must not
+        # overtake the final delta still in flight on the push socket
+        if self._has_residual():
+            self.push({}, force_full=True)
         self._drain()
         self._pull_rpc({"kind": "done", "worker": self.worker})
 
@@ -1039,10 +1454,32 @@ class AsyncSSPClient:
                                    f"({sorted(failed)} failed)")
             time.sleep(0.05)
 
+    def comm_counters(self) -> Dict[str, float]:
+        """Per-link managed-communication telemetry for the engine's
+        display line, stats.yaml and the metrics endpoint
+        (runtime/comm_stats.managed_comm_counters)."""
+        with self._stats_lock:
+            pushed = self.pushed_elems
+            out = {
+                "bytes_sent": float(self.bytes_sent),
+                "bytes_recv": float(self.bytes_recv),
+                "deferred_fraction": (self.deferred_elems / pushed
+                                      if pushed else 0.0),
+                "effective_mbps": round(self._goodput_mbps, 3),
+                "cadence_backoffs": float(self.cadence_backoffs),
+                "partial_pushes": float(self.partial_pushes),
+                "full_pushes": float(self.full_pushes),
+            }
+        return out
+
     def close(self) -> None:
-        # drain so the last clock's update lands before bye (tolerate a
-        # dead sender here — close() runs on failure paths too)
+        # flush any deferred residual, then drain so the last clock's
+        # update lands before bye (tolerate a dead sender here — close()
+        # runs on failure paths too, where the parked bytes become the
+        # failure model's bounded loss)
         try:
+            if self._has_residual():
+                self.push({}, force_full=True)
             self._drain()
         except RuntimeError:
             pass

@@ -54,11 +54,15 @@ class FrameError(ConnectionError):
 MAX_FRAME = 1 << 32
 
 
-def send_frame(sock: socket.socket, obj) -> None:
+def send_frame(sock: socket.socket, obj) -> int:
+    """Send one frame; returns the ACTUAL wire bytes (header + payload) so
+    bandwidth-budgeted callers (the managed-communication token bucket) can
+    account what the link really carried, not an estimate."""
     buf = io.BytesIO()
     pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
     data = buf.getvalue()
     sock.sendall(struct.pack("!Q", len(data)) + data)
+    return len(data) + 8
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -75,7 +79,10 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_frame(sock: socket.socket):
+def recv_frame_sized(sock: socket.socket):
+    """Receive one frame; returns (obj, wire_bytes) — wire_bytes is the
+    actual header + payload byte count, the pull-path input to the managed-
+    communication bandwidth accounting."""
     (n,) = struct.unpack("!Q", recv_exact(sock, 8))
     if n > MAX_FRAME:
         raise FrameError(f"frame length {n} exceeds cap {MAX_FRAME}")
@@ -87,9 +94,13 @@ def recv_frame(sock: socket.socket):
         # header arrived, payload did not: mid-message, not a clean close
         raise FrameError(f"mid-message EOF in payload ({e})") from e
     try:
-        return pickle.loads(payload)
+        return pickle.loads(payload), n + 8
     except Exception as e:  # noqa: BLE001 — any undecodable payload
         raise FrameError(f"bad frame payload: {type(e).__name__}: {e}") from e
+
+
+def recv_frame(sock: socket.socket):
+    return recv_frame_sized(sock)[0]
 
 
 # --------------------------------------------------------------------------- #

@@ -47,10 +47,24 @@ class AsyncSSPTier:
                  liveness_timeout_s: Optional[float] = None,
                  reconnect_deadline_s: Optional[float] = None,
                  gate_timeout_s: float = 120.0,
-                 first_gate_timeout_s: Optional[float] = None):
+                 first_gate_timeout_s: Optional[float] = None,
+                 comm_budget_mbps: Optional[float] = None,
+                 comm_priority_frac: Optional[float] = None,
+                 comm_adaptive: Optional[bool] = None):
         self.rank, self.n_procs, coord = env_world()
         self.staleness = staleness
         self.sync_every = max(1, sync_every)
+        # managed communication (SSPAggr): None knobs resolve against the
+        # global ManagedCommConfig; budget <= 0 keeps the dense path
+        from .. import config as _config
+        mc = _config.managed_comm_config()
+        self.comm_budget_mbps = (mc.budget_mbps if comm_budget_mbps is None
+                                 else comm_budget_mbps)
+        self.comm_priority_frac = (mc.priority_frac
+                                   if comm_priority_frac is None
+                                   else comm_priority_frac)
+        self.comm_adaptive = (mc.adaptive if comm_adaptive is None
+                              else comm_adaptive)
         # SSP gate backstop, configurable from the launcher (the client's
         # hardcoded 120 s default killed healthy runs). The FIRST clock's
         # gate waits on peers that are still JIT-compiling their train
@@ -85,7 +99,11 @@ class AsyncSSPTier:
         self.client = AsyncSSPClient(
             self.rank, (host, port), staleness, n_workers=self.n_procs,
             heartbeat_s=heartbeat_s,
-            reconnect_deadline_s=reconnect_deadline_s)
+            reconnect_deadline_s=reconnect_deadline_s,
+            budget_mbps=(self.comm_budget_mbps
+                         if self.comm_budget_mbps > 0 else None),
+            priority_frac=self.comm_priority_frac,
+            adaptive=self.comm_adaptive)
         # ONE join path for every process biography (join() == the admit
         # RPC, idempotent for existing members):
         # - fresh launch-roster worker: admit is a no-op pull, clock -1;
@@ -118,9 +136,13 @@ class AsyncSSPTier:
         self._iters_since = 0
         self._members: Tuple[int, ...] = tuple(sorted(self.client.members))
         self._t0 = time.time()
+        managed = (f", managed comm {self.comm_budget_mbps:g} Mbit/s "
+                   f"(priority_frac {self.comm_priority_frac:g}, "
+                   f"adaptive {'on' if self.comm_adaptive else 'off'})"
+                   if self.comm_budget_mbps > 0 else "")
         log(f"async-SSP tier: {len(self._members)} members, staleness "
             f"{staleness}, flush every {self.sync_every} iter(s), service "
-            f"{host}:{port}", rank=self.rank)
+            f"{host}:{port}{managed}", rank=self.rank)
 
     # ------------------------------------------------------------------ #
     def data_shard(self) -> Shard:
@@ -151,6 +173,13 @@ class AsyncSSPTier:
         and stats.yaml (runtime/comm_stats.membership_counters)."""
         from .comm_stats import membership_counters
         return membership_counters(service=self.service, client=self.client)
+
+    def comm_counters(self) -> Dict[str, float]:
+        """Per-link managed-communication telemetry (bytes, deferred
+        fraction, goodput, cadence backoffs) for the engine's periodic
+        display and stats.yaml (runtime/comm_stats.managed_comm_counters)."""
+        from .comm_stats import managed_comm_counters
+        return managed_comm_counters(self.client)
 
     # ------------------------------------------------------------------ #
     def after_iters(self, engine, n_iters: int) -> None:
@@ -214,6 +243,10 @@ class AsyncSSPTier:
                "async_gate_blocks": float(self.client.gate_blocks),
                "async_final_clock": float(self.client.clock),
                "async_reconnects": float(self.client.reconnects)}
+        # the per-link managed-communication bill rides the tier summary
+        # (bytes_sent/deferred_fraction/effective_mbps/cadence_backoffs)
+        for k, v in self.comm_counters().items():
+            out[f"async_comm_{k}"] = round(float(v), 4)
         if self.service is not None:
             # poll (not barrier) until the stragglers flush their last
             # clock; None = the CURRENT member set, which under elastic

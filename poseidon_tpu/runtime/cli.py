@@ -102,6 +102,16 @@ def _engine_from_args(args, phase_nets=True):
             v = getattr(args, flag, -1.0)
             if v is not None and v >= 0:
                 async_cfg[key] = v
+        # managed communication (SSPAggr): negative budget = the
+        # ManagedCommConfig default (off); 0 is an explicit "unlimited"
+        v = getattr(args, "comm_budget_mbps", -1.0)
+        if v is not None and v >= 0:
+            async_cfg["comm_budget_mbps"] = v
+        v = getattr(args, "comm_priority_frac", -1.0)
+        if v is not None and v > 0:
+            async_cfg["comm_priority_frac"] = v
+        if getattr(args, "comm_adaptive", False):
+            async_cfg["comm_adaptive"] = True
         staleness = 0
     metrics_port = getattr(args, "metrics_port", -1)
     return Engine(sp, comm=comm, mesh=mesh, mesh_cfg=mesh_cfg,
@@ -757,6 +767,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "jax.distributed world, no cross-process barrier")
     t.add_argument("--async_sync_every", type=int, default=1,
                    help="optimizer iterations per async-SSP flush clock")
+    t.add_argument("--comm_budget_mbps", type=float, default=-1.0,
+                   help="managed communication (SSPAggr): per-link "
+                        "bandwidth budget in Mbit/s for the async-SSP "
+                        "tier, metered as a token bucket over ACTUAL "
+                        "frame bytes on both push and pull channels. A "
+                        "tight budget switches to magnitude-prioritized "
+                        "PARTIAL pushes (top --comm_priority_frac of the "
+                        "delta by |value|, TOPK index+value wire form) "
+                        "with the exact complement carried locally and "
+                        "force-flushed every staleness+1 clocks; read "
+                        "gates run on fully-flushed (durable) clocks so "
+                        "the SSP bound is preserved exactly. <= 0 = "
+                        "unlimited — byte-for-byte the dense path")
+    t.add_argument("--comm_priority_frac", type=float, default=-1.0,
+                   help="fraction of delta entries a budget-tight partial "
+                        "push ships, ranked by |value| across the whole "
+                        "update (default 0.1); negative = the "
+                        "ManagedCommConfig default")
+    t.add_argument("--comm_adaptive", action="store_true",
+                   help="adaptive push cadence: under congestion (token-"
+                        "bucket deficit or flushes queuing behind a slow "
+                        "link) intermediate clocks ship as ~100-byte "
+                        "ticks and the payload rides the next boundary "
+                        "flush, recovering as the link drains "
+                        "(cadence_backoffs counts escalations)")
     t.add_argument("--async_heartbeat_s", type=float, default=-1.0,
                    help="async-SSP client heartbeat cadence (liveness "
                         "signal when the flush queue is idle); negative = "

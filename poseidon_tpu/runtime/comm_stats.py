@@ -219,3 +219,33 @@ def format_membership(counters: Dict[str, float]) -> str:
     """One display line: ``members = 3, admissions = 1, ...`` (ints — the
     counters are counts; float is just the stats-registry convention)."""
     return ", ".join(f"{k} = {int(v)}" for k, v in sorted(counters.items()))
+
+
+# --------------------------------------------------------------------------- #
+# managed-communication telemetry (the async-SSP tier's per-link counters)
+# --------------------------------------------------------------------------- #
+
+def managed_comm_counters(client=None) -> Dict[str, float]:
+    """The async tier's per-link managed-communication counters (SSPAggr
+    accounting), normalized for the engine's periodic display, stats.yaml
+    and the metrics endpoint: actual frame bytes on both channels,
+    the fraction of flush traffic deferred into the residual, measured
+    link goodput, and cadence-backoff escalations. Empty when no client
+    exists (sync tiers)."""
+    if client is None or not hasattr(client, "comm_counters"):
+        return {}
+    return dict(client.comm_counters())
+
+
+def format_comm(counters: Dict[str, float]) -> str:
+    """One display line next to ``[membership]``:
+    ``bytes_sent = 1.2 MB, deferred_fraction = 0.31, ...``."""
+    def fmt(k: str, v: float) -> str:
+        if k.startswith("bytes"):
+            if v >= 1e6:
+                return f"{k} = {v / 1e6:.1f} MB"
+            return f"{k} = {v / 1e3:.1f} kB"
+        if k in ("deferred_fraction", "effective_mbps"):
+            return f"{k} = {v:.3f}"
+        return f"{k} = {int(v)}"
+    return ", ".join(fmt(k, v) for k, v in sorted(counters.items()))

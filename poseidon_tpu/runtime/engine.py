@@ -971,10 +971,22 @@ class Engine:
                         # membership churn rides the display cadence, so
                         # admissions/evictions are visible without
                         # log-grepping (comm_stats.membership_counters)
-                        from .comm_stats import format_membership
+                        from .comm_stats import (format_comm,
+                                                 format_membership)
                         log("    [membership] " + format_membership(
                             self._async_tier.membership_counters()),
                             rank=self.rank)
+                        # the per-link managed-communication bill rides
+                        # the same cadence: bytes on the wire, deferred
+                        # fraction, measured goodput, cadence backoffs —
+                        # gauges feed stats.yaml + the metrics endpoint
+                        cc = self._async_tier.comm_counters()
+                        if cc:
+                            log("    [comm] " + format_comm(cc),
+                                rank=self.rank)
+                            for k, v in cc.items():
+                                self.stats.set_gauge(f"async_comm_{k}",
+                                                     round(float(v), 4))
                 if sp.test_interval and it % sp.test_interval == 0 and \
                         self.test_nets:
                     # test boundary = hard sync point too: never spend a

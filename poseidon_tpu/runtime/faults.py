@@ -14,9 +14,24 @@ Rules (:class:`FaultRule`):
                  succeeds but its first read/write sees EOF/RST. Models a
                  service behind a dead load-balancer slot; exercises the
                  client's backoff-and-redial loop.
-- ``delay``    — forward both directions, adding ``delay_s`` per chunk.
-                 Models a congested DCN hop; exercises that slow != dead
-                 (heartbeats keep the worker un-evicted).
+- ``delay``    — forward both directions, adding ``delay_s`` at the
+                 ``delay_per`` billing granularity: ``"chunk"`` (legacy:
+                 once per 64 KB read — a large frame pays it many times),
+                 ``"frame"`` (once per length-prefixed wire frame — one
+                 rule models the SAME latency for small and large frames;
+                 tracks proto/wire.py's 8-byte big-endian framing, so do
+                 not combine with the raw-byte auth preamble), or
+                 ``"once"`` (once per connection direction — pure
+                 connection-setup latency). Models a congested DCN hop;
+                 exercises that slow != dead (heartbeats keep the worker
+                 un-evicted).
+- ``throttle`` — token-bucket bytes/sec shaping PER DIRECTION
+                 (``rate_bps`` refill, ``burst_bytes`` capacity): each
+                 pump sleeps exactly long enough that its cumulative
+                 forwarded bytes never exceed the budget. The
+                 deterministic substrate for bandwidth-constrained-link
+                 chaos (managed communication's A/B and throttled-fleet
+                 scenarios are reproducible run after run).
 - ``truncate`` — forward exactly ``after_bytes`` of client->server
                  payload, then hard-close both sides. The upstream sees a
                  mid-message EOF (a torn frame); exercises the service's
@@ -39,6 +54,7 @@ partition persists) until lifted.
 from __future__ import annotations
 
 import socket
+import struct
 import threading
 import time
 from dataclasses import dataclass, field
@@ -62,10 +78,13 @@ class FaultRule:
     admit handshake of a worker whose earlier dials already consumed
     unpredictable indices. ``nth`` can."""
 
-    action: str = "sever"          # drop | delay | truncate | sever
+    action: str = "sever"       # drop | delay | truncate | sever | throttle
     conn: Optional[int] = None
     after_bytes: int = 0           # truncate/sever: client->server budget
-    delay_s: float = 0.0           # delay: added latency per chunk
+    delay_s: float = 0.0           # delay: added latency per billing unit
+    delay_per: str = "chunk"       # delay billing: chunk | frame | once
+    rate_bps: float = 0.0          # throttle: bytes/sec per direction
+    burst_bytes: int = 65536       # throttle: token-bucket capacity
     max_conns: Optional[int] = None
     nth: Optional[int] = None      # one-shot: fire on the Nth match only
     hits: int = field(default=0, repr=False)  # connections matched so far
@@ -73,10 +92,15 @@ class FaultRule:
     expired: bool = field(default=False, repr=False)  # nth fired already
 
     def __post_init__(self):
-        if self.action not in ("drop", "delay", "truncate", "sever"):
+        if self.action not in ("drop", "delay", "truncate", "sever",
+                               "throttle"):
             raise ValueError(f"unknown fault action {self.action!r}")
         if self.nth is not None and self.nth < 0:
             raise ValueError(f"nth must be >= 0, got {self.nth}")
+        if self.delay_per not in ("chunk", "frame", "once"):
+            raise ValueError(f"unknown delay_per {self.delay_per!r}")
+        if self.action == "throttle" and self.rate_bps <= 0:
+            raise ValueError("throttle needs rate_bps > 0")
 
 
 class FaultProxy:
@@ -200,14 +224,65 @@ class FaultProxy:
         if rule is not None and rule.action in ("truncate", "sever") and c2s:
             budget = max(0, rule.after_bytes)
         forwarded = 0
+        # delay billing state: "frame" walks the length-prefixed framing
+        # (8-byte big-endian header + payload) through the byte stream and
+        # bills delay_s once per frame STARTED in a chunk; "once" bills a
+        # single time per direction; "chunk" is the legacy per-read bill
+        delaying = (rule is not None and rule.action == "delay"
+                    and rule.delay_s > 0)
+        fr_hdr = b""        # partial header bytes accumulated
+        fr_left = 0         # payload bytes remaining in the current frame
+        delayed_once = False
+        # throttle state: one token bucket PER DIRECTION (each pump call
+        # is one direction), deficit model — overdraft sleeps exactly the
+        # time the budget needs to cover it, so cumulative goodput is
+        # deterministically <= burst + rate * elapsed. Reuses the managed-
+        # communication TokenBucket (parallel/async_ssp.py, jax-free) so
+        # the shaping arithmetic and the client's accounting arithmetic
+        # can never drift apart.
+        throttling = rule is not None and rule.action == "throttle"
+        if throttling:
+            from ..parallel.async_ssp import TokenBucket
+            bucket = TokenBucket(rule.rate_bps,
+                                 burst_bytes=float(rule.burst_bytes))
         try:
             while not self._stop.is_set():
                 data = src.recv(65536)
                 if not data:
                     break
-                if rule is not None and rule.action == "delay" \
-                        and rule.delay_s > 0:
-                    time.sleep(rule.delay_s)
+                if delaying:
+                    if rule.delay_per == "chunk":
+                        time.sleep(rule.delay_s)
+                    elif rule.delay_per == "once":
+                        if not delayed_once:
+                            delayed_once = True
+                            time.sleep(rule.delay_s)
+                    else:  # per frame
+                        frames = 0
+                        i = 0
+                        while i < len(data):
+                            if fr_left == 0:
+                                take = min(8 - len(fr_hdr), len(data) - i)
+                                fr_hdr += data[i:i + take]
+                                i += take
+                                if len(fr_hdr) == 8:
+                                    frames += 1
+                                    (fr_left,) = struct.unpack("!Q", fr_hdr)
+                                    fr_hdr = b""
+                            else:
+                                take = min(fr_left, len(data) - i)
+                                fr_left -= take
+                                i += take
+                        if frames:
+                            time.sleep(rule.delay_s * frames)
+                if throttling:
+                    bucket.consume(len(data))
+                    deficit = -bucket.available()
+                    if deficit > 0:
+                        # sleep off the deficit before forwarding: bytes
+                        # only ever cross at <= the shaped rate (the
+                        # bucket refills during the sleep)
+                        time.sleep(deficit / rule.rate_bps)
                 if budget is not None and forwarded + len(data) >= budget:
                     cut = data[:budget - forwarded]
                     if cut:

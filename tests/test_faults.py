@@ -741,3 +741,171 @@ def test_first_gate_timeout_default_scales_generously(monkeypatch):
     finally:
         tier2.client._stop.set()
         tier2.service.close()
+
+
+# --------------------------------------------------------------------------- #
+# bandwidth shaping: the throttle rule + delay billing granularity
+# --------------------------------------------------------------------------- #
+
+def _echo_server():
+    """Loopback echo upstream for pure data-plane shaping tests."""
+    srv = socket.create_server(("127.0.0.1", 0))
+
+    def accept_loop():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+
+            def serve(c=c):
+                try:
+                    while True:
+                        d = c.recv(65536)
+                        if not d:
+                            return
+                        c.sendall(d)
+                except OSError:
+                    pass
+            threading.Thread(target=serve, daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    return srv
+
+
+def _roundtrip(addr, payload: bytes) -> float:
+    """Send payload through the proxy to the echo server and read it all
+    back; returns elapsed seconds."""
+    c = socket.create_connection(addr)
+    try:
+        t0 = time.monotonic()
+        c.sendall(payload)
+        got = 0
+        while got < len(payload):
+            chunk = c.recv(65536)
+            if not chunk:
+                raise AssertionError(f"connection cut at {got} bytes")
+            got += len(chunk)
+        return time.monotonic() - t0
+    finally:
+        c.close()
+
+
+def test_throttle_rule_shapes_bandwidth_deterministically():
+    """The token-bucket throttle: 250 kB through a 200 kB/s link with a
+    50 kB burst must take >= (250-50)/200 = 1.0 s — and the same run
+    again lands in the same envelope (deterministic shaping, not jitter).
+    An unthrottled control through the same proxy machinery stays fast."""
+    srv = _echo_server()
+    try:
+        control = FaultProxy(srv.getsockname())
+        try:
+            fast = _roundtrip(control.addr, b"x" * 250_000)
+        finally:
+            control.close()
+        proxy = FaultProxy(srv.getsockname())
+        proxy.add_rule(FaultRule(action="throttle", rate_bps=200_000,
+                                 burst_bytes=50_000))
+        try:
+            walls = [_roundtrip(proxy.addr, b"x" * 250_000)
+                     for _ in range(2)]
+        finally:
+            proxy.close()
+        assert fast < min(walls), (fast, walls)
+        for w in walls:
+            # c2s pays (250-50)/200 >= 1.0 s; the echoed s2c direction has
+            # its own bucket and overlaps, so the floor is one direction
+            assert w >= 0.9, walls
+    finally:
+        srv.close()
+
+
+def test_throttle_rule_rejects_zero_rate():
+    with pytest.raises(ValueError, match="rate_bps"):
+        FaultRule(action="throttle")
+
+
+def test_delay_billing_per_frame_vs_per_chunk():
+    """The delay-billing fix: one 1 MB wire frame crosses ~16 recv chunks,
+    so the legacy per-chunk mode bills delay_s ~16x while per-frame bills
+    it once — one rule now models the SAME latency for small and large
+    frames. (Both directions carry the rule; the echo pays it twice.)"""
+    srv = _echo_server()
+    frame = struct.pack("!Q", 1_000_000) + b"y" * 1_000_000
+    try:
+        per_frame = FaultProxy(srv.getsockname())
+        per_frame.add_rule(FaultRule(action="delay", delay_s=0.2,
+                                     delay_per="frame"))
+        try:
+            w_frame = _roundtrip(per_frame.addr, frame)
+        finally:
+            per_frame.close()
+        per_chunk = FaultProxy(srv.getsockname())
+        per_chunk.add_rule(FaultRule(action="delay", delay_s=0.2))
+        try:
+            w_chunk = _roundtrip(per_chunk.addr, frame)
+        finally:
+            per_chunk.close()
+    finally:
+        srv.close()
+    # per-frame: ~2 x 0.2 s (one per direction); per-chunk: >= 16 x 0.2 s
+    # on the c2s direction alone. Upper bounds stay loose (a loaded CI
+    # runner adds scheduling jitter); the per-chunk LOWER bound is the
+    # load-immune half of the discrimination
+    assert w_frame < 2.4, w_frame
+    assert w_chunk > 3.0, w_chunk
+    assert w_chunk > 1.25 * w_frame, (w_chunk, w_frame)
+
+
+def test_delay_billing_once_per_connection():
+    """delay_per='once': connection-setup latency — two frames through
+    one connection pay delay_s once per direction, not per frame."""
+    srv = _echo_server()
+    try:
+        proxy = FaultProxy(srv.getsockname())
+        proxy.add_rule(FaultRule(action="delay", delay_s=0.3,
+                                 delay_per="once"))
+        try:
+            frame = struct.pack("!Q", 100) + b"z" * 100
+            c = socket.create_connection(proxy.addr)
+            try:
+                t0 = time.monotonic()
+                for _ in range(3):
+                    c.sendall(frame)
+                    got = 0
+                    while got < len(frame):
+                        got += len(c.recv(65536))
+                wall = time.monotonic() - t0
+            finally:
+                c.close()
+        finally:
+            proxy.close()
+    finally:
+        srv.close()
+    # one 0.3 s bill per direction = ~0.6 s total, NOT 3 x 2 x 0.3 = 1.8
+    # (bound loose enough for CI scheduling jitter, tight enough to catch
+    # per-frame billing)
+    assert wall < 1.5, wall
+
+
+def test_delay_per_frame_models_small_and_large_frames_alike():
+    """The motivating bug: under per-chunk billing a 100-byte frame and a
+    1 MB frame saw wildly different injected latencies from ONE rule.
+    Per-frame billing makes them equal (within scheduling noise)."""
+    srv = _echo_server()
+    try:
+        proxy = FaultProxy(srv.getsockname())
+        proxy.add_rule(FaultRule(action="delay", delay_s=0.25,
+                                 delay_per="frame"))
+        try:
+            small = _roundtrip(proxy.addr, struct.pack("!Q", 100)
+                               + b"a" * 100)
+            big = _roundtrip(proxy.addr, struct.pack("!Q", 900_000)
+                             + b"b" * 900_000)
+        finally:
+            proxy.close()
+    finally:
+        srv.close()
+    # per-chunk billing would put big ~15 x 0.25 s ahead of small; per-
+    # frame keeps them within scheduling noise (loose CI-safe bound)
+    assert abs(big - small) < 1.2, (small, big)
