@@ -1201,6 +1201,169 @@ def serving_main() -> None:
         "cpu_smoke": cpu_ok,
     })
 
+    if os.environ.get("POSEIDON_BENCH_FLEET", "1") != "0":
+        try:
+            fleet_main(probe)
+        except Exception as e:  # noqa: BLE001 — one JSON line on every path
+            import traceback
+            emit({"metric": "fleet_goodput_rps", "value": 0.0,
+                  "unit": "req/s", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e} | "
+                           f"{traceback.format_exc().strip().splitlines()[-1]}"})
+
+
+# the fleet A/B's synthetic deploy net: heavier than the bench_serve one so
+# a request's dispatch (GIL-free XLA compute) dominates the Python/socket
+# overhead — otherwise the 1-vs-N comparison measures the front door, not
+# the replicas
+FLEET_BENCH_NET = """
+name: "fleet_synthetic"
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 48 input_dim: 48
+layers { name: "conv1" type: CONVOLUTION bottom: "data" top: "conv1"
+  convolution_param { num_output: 48 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers { name: "conv2" type: CONVOLUTION bottom: "conv1" top: "conv2"
+  convolution_param { num_output: 48 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layers { name: "relu2" type: RELU bottom: "conv2" top: "conv2" }
+layers { name: "pool" type: POOLING bottom: "conv2" top: "pool"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layers { name: "fc" type: INNER_PRODUCT bottom: "pool" top: "fc"
+  inner_product_param { num_output: 64 weight_filler { type: "xavier" } } }
+layers { name: "prob" type: SOFTMAX bottom: "fc" top: "prob" }
+"""
+
+
+def fleet_main(probe: dict) -> None:
+    """Fleet A/B: goodput-vs-offered-load curves for 1 vs N replicas
+    behind the same front door (serving/fleet.ReplicaManager), driven by
+    the OPEN-LOOP load generator at 3 offered-load points anchored to the
+    single replica's measured closed-loop capacity C (0.6C under load,
+    1.5C past saturation, 3.0C deep overload). Emits the BENCH-schema
+    lines ``fleet_goodput_rps`` (vs_baseline = N-replica / 1-replica
+    goodput at the top point — the fleet scaling acceptance) and
+    ``fleet_p99_ms`` (vs_baseline = 1-replica / N-replica p99 there).
+
+    Env knobs: POSEIDON_BENCH_FLEET=0 skips, POSEIDON_BENCH_FLEET_REPLICAS
+    (default 3), POSEIDON_BENCH_FLEET_SECONDS per point (default 2.5),
+    POSEIDON_BENCH_FLEET_MODEL/_WEIGHTS (deploy prototxt override)."""
+    import numpy as np
+
+    import jax
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.proto.messages import load_net_from_string
+    from poseidon_tpu.serving.client import run_load
+    from poseidon_tpu.serving.executor import BucketedExecutor
+    from poseidon_tpu.serving.fleet import ReplicaManager
+    from poseidon_tpu.serving.server import InferenceServer
+
+    n_repl = int(os.environ.get("POSEIDON_BENCH_FLEET_REPLICAS", "3"))
+    duration = float(os.environ.get("POSEIDON_BENCH_FLEET_SECONDS", "2.5"))
+    model = os.environ.get("POSEIDON_BENCH_FLEET_MODEL", "")
+    weights = os.environ.get("POSEIDON_BENCH_FLEET_WEIGHTS", "")
+    buckets = (1, 4, 8)
+    rows = 4                     # every request = one bucket-4 dispatch
+    deadline_ms = 400.0          # the goodput SLO: late answers don't count
+    concurrency = 96             # open-loop workers (>> offered x latency;
+    #                              under deep overload a blocked worker means
+    #                              a late fire, which closes the loop)
+
+    if model:
+        # warm=False: this executor only donates net/params to the replica
+        # fleet — warming it would pay a full per-bucket AOT compile for
+        # executables nobody ever dispatches
+        base = BucketedExecutor.from_files(model, weights or None,
+                                           buckets=buckets, warm=False)
+        net, params = base.net, base._params
+    else:
+        net = Net(load_net_from_string(FLEET_BENCH_NET), "TEST")
+        params = net.init(jax.random.PRNGKey(0))
+    devs = jax.devices()
+
+    def make_fleet(n: int) -> ReplicaManager:
+        # pin round-robin across local devices (on the CPU proxy that is
+        # one device — concurrency still comes from N flush threads
+        # dispatching GIL-free XLA executions)
+        exs = [BucketedExecutor(net, params, buckets=buckets,
+                                device=devs[i % len(devs)])
+               for i in range(n)]
+        # batching/admission knobs belong to the replicas' batchers (the
+        # fleet-mode server ignores its own): tight flush deadline, deep
+        # admission queue so overload turns into deadline misses and
+        # sheds, not instant refusals
+        return ReplicaManager(exs, devices=[str(devs[i % len(devs)])
+                                            for i in range(n)],
+                              max_delay_s=0.002, max_queue=128)
+
+    name = net.input_names[0]
+    row_shape = tuple(net.blob_shapes[name][1:])
+    frame = np.random.RandomState(0).randn(rows,
+                                           *row_shape).astype(np.float32)
+
+    def mk(i):
+        return {name: frame}
+
+    def drive(n_replicas: int, points) -> dict:
+        fleet = make_fleet(n_replicas)
+        server = InferenceServer(fleet=fleet)
+        arm = {"replicas": n_replicas, "points": {}}
+        try:
+            if points is None:
+                # closed-loop capacity probe: what ONE replica sustains
+                # probed at enough closed-loop workers to saturate the
+                # micro-batcher (packing raises capacity vs a serial
+                # probe); the curve itself uses a larger OPEN-loop pool
+                # purely to keep arrivals on schedule under overload
+                cap = run_load(server.addr, mk, n_requests=400,
+                               concurrency=24)
+                arm["capacity_rps"] = cap["throughput_rps"]
+                # floor at 1 req/s: a pathologically slow model must not
+                # produce an offered point of 0 (run_load refuses it)
+                points = [max(1.0, round(cap["throughput_rps"] * f, 1))
+                          for f in (0.6, 1.5, 3.0)]
+            arm["offered_points_rps"] = points
+            for rps in points:
+                n = max(80, int(rps * duration))
+                r = run_load(server.addr, mk, n_requests=n,
+                             concurrency=concurrency,
+                             deadline_ms=deadline_ms, offered_rps=rps)
+                arm["points"][str(rps)] = {
+                    k: r.get(k) for k in
+                    ("goodput_rps", "p50_ms", "p99_ms", "ok", "shed",
+                     "deadline", "error", "late_fires", "achieved_rps")}
+        finally:
+            server.shutdown()
+        return arm
+
+    one = drive(1, None)
+    many = drive(n_repl, one["offered_points_rps"])
+    top = str(one["offered_points_rps"][-1])
+    g1 = one["points"][top]["goodput_rps"] or 0.0
+    gN = many["points"][top]["goodput_rps"] or 0.0
+    speedup = round(gN / g1, 3) if g1 else 0.0
+    cfg = {
+        "cpu_proxy": probe.get("platform") not in ("tpu", "axon"),
+        "platform": probe.get("platform"),
+        "replicas": n_repl,
+        "request_rows": rows,
+        "deadline_ms": deadline_ms,
+        "duration_s_per_point": duration,
+        "offered_points_rps": one["offered_points_rps"],
+    }
+    emit({"metric": "fleet_goodput_rps", "value": gN, "unit": "req/s",
+          "vs_baseline": speedup, "goodput_speedup_at_top_offered": speedup,
+          **cfg, "one_replica": one, "fleet": many})
+    p99_1 = one["points"][top]["p99_ms"] or 0.0
+    p99_N = many["points"][top]["p99_ms"] or 0.0
+    emit({"metric": "fleet_p99_ms", "value": p99_N, "unit": "ms",
+          "vs_baseline": round(p99_1 / p99_N, 3) if p99_N else 0.0,
+          **cfg,
+          "one_replica_p99_ms": p99_1,
+          "curve_one": {k: v["p99_ms"] for k, v in one["points"].items()},
+          "curve_fleet": {k: v["p99_ms"] for k, v in many["points"].items()}})
+
 
 # --------------------------------------------------------------------------- #
 # attribution mode: `python bench.py attribution [--model alexnet]`

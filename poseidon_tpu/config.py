@@ -182,6 +182,43 @@ def set_managed_comm_config(**kwargs) -> None:
 
 
 @dataclass
+class FleetConfig:
+    """Serving-fleet policy (serving/fleet.py): how many replicas the
+    front door fans out to, where they pin, and the health/reload knobs.
+    Dependency-free (the serve CLI parses it before jax loads); replicas=1
+    with no device pinning is byte-for-byte the single-engine PR-2 path."""
+
+    # engines behind the front door, each its own executor + micro-batcher
+    replicas: int = 1
+    # comma-separated indices into jax.devices() to pin replicas to
+    # ("" = round-robin over all local devices when replicas > 1)
+    devices: str = ""
+    # consecutive dispatch failures (or wedged-submit timeouts) before a
+    # replica is marked DEAD and its queue reroutes
+    failure_threshold: int = 1
+    # rolling reload: how long one replica may take to drain before its
+    # swap is skipped this pass
+    drain_timeout_s: float = 30.0
+    # how often the server refreshes the stats-registry "serving" section
+    # for the metrics endpoint (<= 0 = only on stats-op reads)
+    stats_refresh_s: float = 2.0
+
+
+_fleet = FleetConfig()
+
+
+def fleet_config() -> FleetConfig:
+    return _fleet
+
+
+def set_fleet_config(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_fleet, k):
+            raise AttributeError(k)
+        setattr(_fleet, k, v)
+
+
+@dataclass
 class PipelineConfig:
     """Step-pipeline policy for the training loop (runtime/engine.py).
 

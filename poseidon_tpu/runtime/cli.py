@@ -442,14 +442,17 @@ layers { name: "prob" type: SOFTMAX bottom: "fc" top: "prob" }
 """
 
 
-def _build_serving_executor(model: str, weights: str, buckets: str):
+def _build_serving_executor(model: str, weights: str, buckets: str,
+                            device=None):
     """Shared by serve/bench_serve: deploy net (or the built-in synthetic
-    one) + optional weights -> warmed BucketedExecutor."""
+    one) + optional weights -> warmed BucketedExecutor, optionally pinned
+    to one local device (the fleet's placement unit)."""
     from ..serving.executor import BucketedExecutor, parse_buckets
     bucket_sizes = parse_buckets(buckets)
     if model:
         return BucketedExecutor.from_files(model, weights or None,
-                                           buckets=bucket_sizes)
+                                           buckets=bucket_sizes,
+                                           device=device)
     import jax
     from ..core.net import Net
     from ..proto.messages import load_net_from_string
@@ -458,17 +461,65 @@ def _build_serving_executor(model: str, weights: str, buckets: str):
     if weights:
         from ..serving.executor import load_serving_params
         params = load_serving_params(net, params, weights)
-    return BucketedExecutor(net, params, buckets=bucket_sizes)
+    return BucketedExecutor(net, params, buckets=bucket_sizes,
+                            device=device)
+
+
+def _resolve_fleet_devices(spec: str, n_replicas: int):
+    """``--devices "0,2,3"`` -> the named jax devices; "" -> round-robin
+    over every local device when the fleet has more than one replica (a
+    single replica keeps the default device). Asking for an index that
+    does not exist fails loudly — the make_mesh lesson: never silently
+    truncate a placement request."""
+    import jax
+    local = jax.devices()
+    if spec:
+        try:
+            idxs = [int(tok) for tok in spec.split(",") if tok.strip()]
+        except ValueError:
+            raise SystemExit(f"--devices {spec!r}: expected comma-separated "
+                             f"device indices") from None
+        bad = [i for i in idxs if i < 0 or i >= len(local)]
+        if bad:
+            raise SystemExit(f"--devices {spec!r}: no such device index "
+                             f"{bad} (have {len(local)} local devices)")
+        return [local[i] for i in idxs]
+    if n_replicas <= 1:
+        return []
+    return list(local)
+
+
+def build_serving_fleet(model: str, weights: str, buckets: str,
+                        n_replicas: int, devices_spec: str = "",
+                        max_delay_s: float = 0.005, max_queue: int = 64,
+                        warm_async: bool = False, **manager_kw):
+    """N warmed replicas under one :class:`ReplicaManager`, round-robin
+    pinned across the resolved devices (replicas > devices is fine — CPU
+    proxies and oversubscribed hosts still get N independent engines)."""
+    from ..serving.fleet import ReplicaManager
+    devices = _resolve_fleet_devices(devices_spec, n_replicas)
+
+    def factory(device):
+        return _build_serving_executor(model, weights, buckets,
+                                       device=device)
+
+    return ReplicaManager.build(factory, n_replicas, devices=devices,
+                                warm_async=warm_async,
+                                max_delay_s=max_delay_s,
+                                max_queue=max_queue, **manager_kw)
 
 
 def cmd_serve(args) -> int:
     """Serve a trained snapshot over TCP: dynamic micro-batching, a
     shape-bucketed AOT compile cache, checkpoint hot-reload, and graceful
-    drain on SIGTERM/SIGINT (exit 0, no request silently dropped)."""
+    drain on SIGTERM/SIGINT (exit 0, no request silently dropped).
+    ``--replicas N`` puts a replica fleet behind the same front door:
+    least-loaded routing, per-replica health/failover, rolling reload."""
     import json
     import signal
 
-    from ..serving.reloader import CheckpointReloader
+    from ..config import fleet_config
+    from ..serving.reloader import CheckpointReloader, FleetReloader
     from ..serving.server import InferenceServer
     from .metrics import log
 
@@ -489,34 +540,68 @@ def cmd_serve(args) -> int:
                 "--watch auto needs --weights pointing at a "
                 "<prefix>_iter_N artifact to derive the prefix from; "
                 "pass the snapshot prefix explicitly instead")
-    executor = _build_serving_executor(args.model, args.weights, args.buckets)
-    log(f"serve: warmed buckets {executor.buckets} "
-        f"({executor.net.name or 'net'}, "
-        f"{executor.net.param_count()} params)")
+    # when --weights is itself a snapshot under the watch prefix, seed
+    # the reloader with it so the first poll only swaps to something
+    # strictly newer (never a redundant or backwards swap)
+    serving_snap = (args.weights if watch and args.weights
+                    and "_iter_" in args.weights
+                    and args.weights.split("_iter_")[0] == watch
+                    else None)
+    replicas = max(1, getattr(args, "replicas", 1))
+    fleet_mode = replicas > 1 or bool(getattr(args, "devices", ""))
     reloader = None
+    if fleet_mode:
+        manager = build_serving_fleet(
+            args.model, args.weights, args.buckets, replicas,
+            getattr(args, "devices", ""),
+            max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue)
+        ref = manager.reference_executor()
+        log(f"serve: warmed {len(manager.replicas)} replicas, buckets "
+            f"{ref.buckets} ({ref.net.name or 'net'}, "
+            f"{ref.net.param_count()} params each)")
+        if watch:
+            reloader = FleetReloader(manager, watch, poll_s=args.poll_s,
+                                     current_path=serving_snap)
+    else:
+        executor = _build_serving_executor(args.model, args.weights,
+                                           args.buckets)
+        log(f"serve: warmed buckets {executor.buckets} "
+            f"({executor.net.name or 'net'}, "
+            f"{executor.net.param_count()} params)")
+        if watch:
+            reloader = CheckpointReloader(executor, watch,
+                                          poll_s=args.poll_s,
+                                          current_path=serving_snap)
     if watch:
-        # when --weights is itself a snapshot under the watch prefix, seed
-        # the reloader with it so the first poll only swaps to something
-        # strictly newer (never a redundant or backwards swap)
-        serving_snap = (args.weights if args.weights
-                        and "_iter_" in args.weights
-                        and args.weights.split("_iter_")[0] == watch
-                        else None)
-        reloader = CheckpointReloader(executor, watch, poll_s=args.poll_s,
-                                      current_path=serving_snap)
         log(f"serve: watching {watch!r} for newer snapshots "
             f"(every {args.poll_s}s)")
     if args.host not in ("127.0.0.1", "localhost", "::1"):
         log(f"serve: WARNING: binding {args.host!r} — the wire format is "
             f"pickled frames (arbitrary code execution for anyone who can "
             f"connect); serve only on loopback or a trusted network")
+    metrics_port = getattr(args, "metrics_port", -1)
     server = InferenceServer(
-        executor, host=args.host, port=args.port,
+        executor=None if fleet_mode else executor,
+        fleet=manager if fleet_mode else None,
+        host=args.host, port=args.port,
         max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
         default_deadline_s=(args.deadline_ms / 1e3
                             if args.deadline_ms > 0 else None),
-        reloader=reloader)
-    log(f"serve: listening on {server.host}:{server.port}")
+        reloader=reloader,
+        # the refresher keeps the registry section live for ANY metrics
+        # endpoint (single-engine included — a once-seeded section would
+        # read as a frozen server), and for the fleet health surface
+        stats_refresh_s=(fleet_config().stats_refresh_s
+                         if fleet_mode or metrics_port >= 0 else 0.0))
+    log(f"serve: listening on {server.host}:{server.port}"
+        + (f" ({replicas} replicas)" if fleet_mode else ""))
+    metrics_srv = None
+    if metrics_port >= 0:
+        from .metrics import MetricsServer
+        server.stats_snapshot()        # seed the section before first poll
+        metrics_srv = MetricsServer(server.stats, port=metrics_port)
+        log(f"serve: metrics endpoint on "
+            f"http://127.0.0.1:{metrics_srv.port}/ (fleet health surface)")
 
     def _graceful(signum, frame):
         log(f"serve: signal {signum}; draining in-flight requests")
@@ -531,6 +616,8 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         pass
     server.shutdown(drain=True)
+    if metrics_srv is not None:
+        metrics_srv.close()
     print(json.dumps({"serving_final_stats": server.stats_snapshot()}),
           flush=True)
     return 0
@@ -538,21 +625,29 @@ def cmd_serve(args) -> int:
 
 def run_serving_bench(executor, requests: int, concurrency: int, batch: int,
                       max_delay_ms: float = 5.0, max_queue: int = 64,
-                      deadline_ms=None):
+                      deadline_ms=None, fleet=None, offered_rps=None):
     """The in-process serving bench driver shared by `bench_serve` and
     bench.py's serving mode: port-0 server + the load generator, request
-    sizes cycling 1..batch over the bucket ladder. Returns
-    (run_load result, server stats snapshot)."""
+    sizes cycling 1..batch over the bucket ladder. Pass ``fleet`` (a
+    ReplicaManager; ``executor=None``) to stand the whole fleet behind
+    the front door, and ``offered_rps`` for the open-loop arrival-rate
+    mode. Returns (run_load result, server stats snapshot)."""
     import numpy as np
 
     from ..serving.client import run_load
     from ..serving.server import InferenceServer
 
-    server = InferenceServer(executor, max_delay_s=max_delay_ms / 1e3,
-                             max_queue=max_queue)
-    name = executor.input_names[0]
-    row_shape = tuple(executor.net.blob_shapes[name][1:])
-    max_rows = max(1, min(batch, executor.max_batch))
+    # batching/admission knobs live on the REPLICAS in fleet mode (each
+    # batcher was configured at build_serving_fleet time); passing them to
+    # the server there would be a silent no-op
+    server = (InferenceServer(fleet=fleet) if fleet is not None else
+              InferenceServer(executor=executor,
+                              max_delay_s=max_delay_ms / 1e3,
+                              max_queue=max_queue))
+    ref = executor if executor is not None else fleet.reference_executor()
+    name = ref.input_names[0]
+    row_shape = tuple(ref.net.blob_shapes[name][1:])
+    max_rows = max(1, min(batch, ref.max_batch))
     frames = np.random.RandomState(0).randn(
         max_rows, *row_shape).astype(np.float32)
 
@@ -561,7 +656,8 @@ def run_serving_bench(executor, requests: int, concurrency: int, batch: int,
 
     try:
         result = run_load(server.addr, make_inputs, n_requests=requests,
-                          concurrency=concurrency, deadline_ms=deadline_ms)
+                          concurrency=concurrency, deadline_ms=deadline_ms,
+                          offered_rps=offered_rps)
         stats = server.stats_snapshot()
     finally:
         server.shutdown()
@@ -571,18 +667,43 @@ def run_serving_bench(executor, requests: int, concurrency: int, batch: int,
 def cmd_bench_serve(args) -> int:
     """In-process serving latency microbenchmark: stand the server up on
     port 0, drive it with the shared load generator, print ONE JSON line
-    (p50/p99/throughput + shed/fill telemetry)."""
+    (p50/p99/throughput + shed/fill telemetry). ``--replicas N`` benches
+    the fleet path; ``--offered_rps R`` switches the generator to the
+    open-loop arrival-rate mode (goodput-vs-offered-load measurable)."""
     import json
 
     _enable_compile_cache_from_args(args)
-    executor = _build_serving_executor(args.model, args.weights, args.buckets)
+    replicas = max(1, getattr(args, "replicas", 1))
+    offered = (args.offered_rps if getattr(args, "offered_rps", 0) > 0
+               else None)
+    if replicas > 1 or getattr(args, "devices", ""):
+        fleet = build_serving_fleet(
+            args.model, args.weights, args.buckets, replicas,
+            getattr(args, "devices", ""),
+            max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue)
+        executor = None
+    else:
+        fleet = None
+        executor = _build_serving_executor(args.model, args.weights,
+                                           args.buckets)
     result, stats = run_serving_bench(
         executor, args.requests, args.concurrency, args.batch,
         max_delay_ms=args.max_delay_ms, max_queue=args.max_queue,
-        deadline_ms=args.deadline_ms if args.deadline_ms > 0 else None)
-    result["batch_fill"] = stats["batch_fill"]
-    result["batches"] = stats["batches"]
-    result["bucket_calls"] = stats["bucket_calls"]
+        deadline_ms=args.deadline_ms if args.deadline_ms > 0 else None,
+        fleet=fleet, offered_rps=offered)
+    if fleet is not None:
+        result["replicas"] = replicas
+        result["routing"] = stats["routing"]
+        result["states"] = stats["states"]
+        result["batches"] = stats["batches"]
+        fills = [r.get("batch_fill") for r in stats["replicas"].values()
+                 if r.get("batch_fill") is not None]
+        result["batch_fill"] = (round(sum(fills) / len(fills), 4)
+                                if fills else None)
+    else:
+        result["batch_fill"] = stats["batch_fill"]
+        result["batches"] = stats["batches"]
+        result["bucket_calls"] = stats["bucket_calls"]
     if not result.get("ok") or result.get("p99_ms") is None:
         # every request shed/errored: fail loudly, never a clean 0.0 line
         # (spread result FIRST — it carries an integer "error" counter that
@@ -938,6 +1059,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="default per-request deadline (0 = none)")
     sv.add_argument("--poll_s", type=float, default=1.0,
                     help="hot-reload watch cadence")
+    sv.add_argument("--replicas", type=int, default=1,
+                    help="serving replicas behind the one front door, "
+                         "each its own bucketed executor + micro-batcher "
+                         "(least-loaded routing, per-replica health, "
+                         "rolling hot-reload); 1 = the single-engine path")
+    sv.add_argument("--devices", default="",
+                    help="comma-separated jax.devices() indices to pin "
+                         "replicas to (e.g. '0,1,2'); empty round-robins "
+                         "over all local devices when --replicas > 1")
+    sv.add_argument("--metrics_port", type=int, default=-1,
+                    help="serve live fleet health over HTTP on this port "
+                         "(0 = ephemeral, printed at startup; the same "
+                         "read-only endpoint as train's --metrics_port)")
     sv.add_argument("--compile_cache_dir", default="",
                     help="persistent XLA compile cache: a restarted "
                          "replica's bucket warm-up compiles become disk "
@@ -960,6 +1094,13 @@ def build_parser() -> argparse.ArgumentParser:
     bs.add_argument("--max_delay_ms", type=float, default=5.0)
     bs.add_argument("--max_queue", type=int, default=64)
     bs.add_argument("--deadline_ms", type=float, default=0.0)
+    bs.add_argument("--replicas", type=int, default=1,
+                    help="bench the fleet path with this many replicas")
+    bs.add_argument("--devices", default="",
+                    help="device indices to pin the replicas to")
+    bs.add_argument("--offered_rps", type=float, default=0.0,
+                    help="open-loop mode: fixed arrival rate (req/s); "
+                         "0 = closed loop")
     bs.add_argument("--compile_cache_dir", default="")
     bs.set_defaults(fn=cmd_bench_serve)
 

@@ -432,6 +432,32 @@ class LatencyWindow:
             "mean_ms": round(sum(data) / len(data) * 1e3, 3),
         }
 
+    def samples(self) -> List[float]:
+        """A copy of the current window (seconds) — merge fodder."""
+        with self._lock:
+            return list(self._samples)
+
+    @classmethod
+    def merged_summary(cls, windows) -> Dict[str, float]:
+        """One summary over the POOLED samples of many windows (the fleet
+        aggregation: per-replica percentiles do not average, so the fleet
+        row re-ranks the union instead). Counts sum over lifetimes; the
+        percentile pool is bounded by each window's maxlen."""
+        data: List[float] = []
+        total = 0
+        for w in windows:
+            data.extend(w.samples())
+            total += w.count
+        if not data:
+            return {"count": 0}
+        data.sort()
+        return {
+            "count": total,
+            "p50_ms": round(cls._rank(data, 50.0) * 1e3, 3),
+            "p99_ms": round(cls._rank(data, 99.0) * 1e3, 3),
+            "mean_ms": round(sum(data) / len(data) * 1e3, 3),
+        }
+
 
 def log(msg: str, *, rank: int = 0) -> None:
     """Rank-0-only progress logging, the reference's client0/thread0 idiom."""

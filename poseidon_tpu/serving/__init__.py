@@ -12,8 +12,14 @@ subsystem next to training:
   with per-request deadlines and a stats introspection op
 - :mod:`reloader`  — checkpoint hot-reload: watch the snapshot directory and
   atomically swap serving params without dropping in-flight requests
+  (``FleetReloader`` generalizes it to roll a whole fleet, one drain at a
+  time)
+- :mod:`fleet`     — the replica manager: N executors behind one front door
+  with least-loaded routing, WARMING/SERVING/DRAINING/DEAD health states,
+  failover on replica death, and rolling hot-reload
 - :mod:`client`    — small blocking client (retry_with_backoff) + load
-  generator shared by tests, bench.py's serving mode, and `bench_serve`
+  generator (closed-loop and open-loop offered-load modes) shared by
+  tests, bench.py's serving mode, and `bench_serve`
 
 PEP-562 lazy exports keep ``import poseidon_tpu.serving`` jax-free until an
 executor is actually built (client/server/batcher never import jax).
@@ -27,6 +33,9 @@ _EXPORTS = {
     "DeadlineError": ".batcher",
     "InferenceServer": ".server",
     "CheckpointReloader": ".reloader",
+    "FleetReloader": ".reloader",
+    "ReplicaManager": ".fleet",
+    "Replica": ".fleet",
     "ServingClient": ".client",
     "ServingError": ".client",
     "run_load": ".client",

@@ -37,11 +37,19 @@ import numpy as np
 
 from ..runtime.metrics import LatencyWindow
 
-__all__ = ["DynamicBatcher", "ShedError", "DeadlineError"]
+__all__ = ["DynamicBatcher", "ShedError", "ShuttingDownError",
+           "DeadlineError"]
 
 
 class ShedError(RuntimeError):
     """Admission refused: the bounded queue is full (backpressure)."""
+
+
+class ShuttingDownError(ShedError):
+    """Admission refused because the batcher is closing — the TYPED
+    marker the fleet router needs to tell a shutdown shed (surface it)
+    from a queue-full shed (try another replica) without matching on
+    message text."""
 
 
 class DeadlineError(RuntimeError):
@@ -92,6 +100,7 @@ class DynamicBatcher:
         self.batches = 0
         self.batched_rows = 0
         self._fill_sum = 0.0               # sum of rows/max_batch per flush
+        self._inflight_rows = 0            # rows in the batch being dispatched
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -124,7 +133,7 @@ class DynamicBatcher:
         req = _Pending(inputs, rows, deadline)
         with self._lock:
             if self._closing:
-                raise ShedError("server is shutting down")
+                raise ShuttingDownError("server is shutting down")
             if len(self._q) >= self.max_queue:
                 self.shed_count += 1
                 raise ShedError(
@@ -152,6 +161,41 @@ class DynamicBatcher:
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._q)
+
+    @property
+    def inflight_rows(self) -> int:
+        """Rows in the micro-batch currently on the executor (0 between
+        flushes)."""
+        with self._lock:
+            return self._inflight_rows
+
+    def load_score(self) -> float:
+        """The fleet router's signal: queued requests plus the in-flight
+        batch's fill fraction. 0.0 = idle; +1 per queued request; the
+        fractional part is how full the batch on the device is — two
+        replicas with empty queues still order by who is dispatching
+        more."""
+        with self._lock:
+            return len(self._q) + self._inflight_rows / self.max_batch
+
+    def idle(self) -> bool:
+        """Nothing queued AND nothing on the executor — the rolling
+        reloader's swap-is-safe condition (paired read: both halves from
+        one lock hold)."""
+        with self._lock:
+            return not self._q and self._inflight_rows == 0
+
+    def wait_idle(self, timeout_s: float = 30.0,
+                  poll_s: float = 0.005) -> bool:
+        """Block until :meth:`idle` (the drain half of drain-and-swap).
+        Returns False on timeout — a batcher that cannot drain is wedged,
+        which is the failure detector's business, not the reloader's."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.idle():
+                return True
+            time.sleep(poll_s)
+        return self.idle()
 
     def fill_ratio(self) -> Optional[float]:
         """Mean rows/max_batch over all flushed micro-batches."""
@@ -181,6 +225,11 @@ class DynamicBatcher:
                             r = self._q.popleft()
                             batch.append(r)
                             rows += r.rows
+                        # counted under the SAME lock hold that popped the
+                        # queue: idle() can never observe "queue empty,
+                        # nothing in flight" while popped requests are
+                        # still owed results
+                        self._inflight_rows = rows
                         return batch
                     self._wake.wait(timeout=self.max_delay_s - age)
                 elif self._closing:
@@ -211,6 +260,8 @@ class DynamicBatcher:
                 else:
                     live.append(r)
             if not live:
+                with self._lock:
+                    self._inflight_rows = 0
                 continue
             rows = sum(r.rows for r in live)
             try:
@@ -223,6 +274,8 @@ class DynamicBatcher:
                 for r in live:
                     r.error = e
                     r.event.set()
+                with self._lock:
+                    self._inflight_rows = 0
                 continue
             # flush-thread counters race the /stats handler threads (and
             # fill_ratio's two-field read) without the lock: a lost
@@ -240,6 +293,8 @@ class DynamicBatcher:
                     for k, v in out.items()}
                 off += r.rows
                 r.event.set()
+            with self._lock:
+                self._inflight_rows = 0
 
     # ---- shutdown -------------------------------------------------------- #
     def close(self, drain: bool = True, timeout_s: float = 30.0) -> None:
@@ -255,6 +310,6 @@ class DynamicBatcher:
                 leftovers = []
             self._wake.notify_all()
         for r in leftovers:
-            r.error = ShedError("server shut down before dispatch")
+            r.error = ShuttingDownError("server shut down before dispatch")
             r.event.set()
         self._thread.join(timeout=timeout_s)

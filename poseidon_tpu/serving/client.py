@@ -143,18 +143,41 @@ def run_load(addr: Tuple[str, int],
              make_inputs: Callable[[int], Dict[str, np.ndarray]],
              n_requests: int = 200, concurrency: int = 4,
              deadline_ms: Optional[float] = None,
-             retry_deadline_s: float = 10.0) -> Dict:
+             retry_deadline_s: float = 10.0,
+             offered_rps: Optional[float] = None) -> Dict:
     """Drive ``n_requests`` inferences through ``concurrency`` persistent
-    client connections; returns p50/p99/throughput plus shed/error counts.
+    client connections; returns p50/p99/goodput plus shed/error counts.
+
+    Two load models:
+
+    - **closed loop** (``offered_rps=None``, the default): each worker
+      fires its next request the moment the previous reply lands. Load
+      self-throttles to whatever the server sustains — fine for a latency
+      floor, useless for a saturation curve (an overloaded server slows
+      the generator down instead of being measured as overloaded).
+    - **open loop** (``offered_rps=R``): request i has the fixed arrival
+      time ``t0 + i/R``, independent of completions. A worker sleeps
+      until its request's slot; a worker still waiting on a reply when
+      its next slot passes fires late and is COUNTED (``late_fires`` —
+      nonzero means concurrency is too low to realize the offered rate,
+      i.e. the generator partially closed the loop). Goodput-vs-offered-
+      load is measurable: offer 2x capacity and goodput saturates while
+      sheds/deadlines absorb the rest.
 
     ``make_inputs(i)`` builds request i's input dict (vary batch sizes to
     exercise the bucket ladder). Sheds are counted, not retried — a bench
     that silently retried its way around backpressure would report a
     throughput the server cannot actually sustain."""
+    if offered_rps is not None and offered_rps <= 0:
+        # a zero rate would ZeroDivisionError inside every worker thread
+        # (which dies silently) — refuse it loudly at the call site
+        raise ValueError(f"offered_rps must be > 0, got {offered_rps}")
     lat = LatencyWindow(maxlen=max(2048, n_requests))
     counters = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+    late = {"v": 0}
     counters_lock = threading.Lock()
     next_i = {"v": 0}
+    t_start = time.monotonic()
 
     def worker() -> None:
         cli = ServingClient(addr, retry_deadline_s=retry_deadline_s)
@@ -165,6 +188,16 @@ def run_load(addr: Tuple[str, int],
                     if i >= n_requests:
                         return
                     next_i["v"] = i + 1
+                if offered_rps is not None:
+                    slot = t_start + i / offered_rps
+                    lag = time.monotonic() - slot
+                    if lag < 0:
+                        time.sleep(-lag)
+                    elif lag > 0.5 / offered_rps:
+                        # past its slot by over half a period: the open
+                        # loop is partially closed — count it
+                        with counters_lock:
+                            late["v"] += 1
                 t0 = time.monotonic()
                 try:
                     cli.infer(make_inputs(i), deadline_ms=deadline_ms)
@@ -182,20 +215,28 @@ def run_load(addr: Tuple[str, int],
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(max(1, concurrency))]
-    t_start = time.monotonic()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     wall = max(time.monotonic() - t_start, 1e-9)
     summary = lat.summary()
-    return {
+    out = {
         **counters,
         "requests": n_requests,
         "concurrency": concurrency,
         "wall_s": round(wall, 4),
         "throughput_rps": round(counters["ok"] / wall, 2),
+        "goodput_rps": round(counters["ok"] / wall, 2),
         "p50_ms": summary.get("p50_ms"),
         "p99_ms": summary.get("p99_ms"),
         "mean_ms": summary.get("mean_ms"),
     }
+    if offered_rps is not None:
+        sent = sum(counters.values())
+        out.update({
+            "offered_rps": round(float(offered_rps), 2),
+            "achieved_rps": round(sent / wall, 2),
+            "late_fires": late["v"],
+        })
+    return out

@@ -89,7 +89,7 @@ class BucketedExecutor:
     nets that kept a loss head) passes through untouched."""
 
     def __init__(self, net, params, buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 warm: bool = True):
+                 warm: bool = True, device=None):
         import jax
         import jax.numpy as jnp
 
@@ -102,7 +102,15 @@ class BucketedExecutor:
         self.input_names: List[str] = list(net.input_names)
         if not self.input_names:
             raise ValueError("net declares no inputs to serve")
-        self._params = jax.tree_util.tree_map(jnp.asarray, params)
+        # device pinning (the fleet's placement half): params live committed
+        # on the pinned device and every bucket compiles FOR it, so N
+        # replicas on N local devices never contend for one accelerator
+        self.device = device
+        if device is not None:
+            self._params = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, params), device)
+        else:
+            self._params = jax.tree_util.tree_map(jnp.asarray, params)
         self._swap_lock = threading.Lock()
         self.params_version = 0            # bumped by every swap_params
         self.calls: Dict[int, int] = {b: 0 for b in self.buckets}
@@ -131,17 +139,27 @@ class BucketedExecutor:
         return jax.ShapeDtypeStruct((bucket,) + tuple(shape[1:]), dtype)
 
     def warm(self) -> None:
-        """AOT-compile every bucket so no request ever pays trace cost."""
+        """AOT-compile every bucket so no request ever pays trace cost.
+        With a pinned device the lowering runs under ``default_device``,
+        baking the executable's placement (uncommitted request arrays then
+        land there at dispatch)."""
+        import contextlib
+
         import jax
 
         params_avals = jax.tree_util.tree_map(
             lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), self._params)
-        for b in self.buckets:
-            if b in self._compiled:
-                continue
-            inputs = {n: self._input_aval(n, b) for n in self.input_names}
-            self._compiled[b] = (
-                jax.jit(self._fwd).lower(params_avals, inputs).compile())
+        ctx = (jax.default_device(self.device) if self.device is not None
+               else contextlib.nullcontext())
+        with ctx:
+            for b in self.buckets:
+                if b in self._compiled:
+                    continue
+                inputs = {n: self._input_aval(n, b)
+                          for n in self.input_names}
+                self._compiled[b] = (
+                    jax.jit(self._fwd).lower(params_avals,
+                                             inputs).compile())
 
     def bucket_for(self, rows: int) -> int:
         if rows < 1:
@@ -232,6 +250,10 @@ class BucketedExecutor:
         import jax.numpy as jnp
 
         new_params = jax.tree_util.tree_map(jnp.asarray, new_params)
+        if self.device is not None:
+            # the executables are pinned: a swap must land the new tree on
+            # THIS replica's device, not wherever the snapshot loaded
+            new_params = jax.device_put(new_params, self.device)
         cur_leaves, cur_tree = jax.tree_util.tree_flatten(self._params)
         new_leaves, new_tree = jax.tree_util.tree_flatten(new_params)
         if cur_tree != new_tree:
@@ -251,7 +273,7 @@ class BucketedExecutor:
     @classmethod
     def from_files(cls, model_path: str, weights_path: Optional[str] = None,
                    buckets: Sequence[int] = DEFAULT_BUCKETS,
-                   warm: bool = True) -> "BucketedExecutor":
+                   warm: bool = True, device=None) -> "BucketedExecutor":
         """Build from a deploy prototxt + optional weights (.caffemodel or
         .solverstate.npz). Without weights the net serves its filler
         initialization (smoke mode)."""
@@ -263,4 +285,4 @@ class BucketedExecutor:
         params = net.init(jax.random.PRNGKey(0))
         if weights_path:
             params = load_serving_params(net, params, weights_path)
-        return cls(net, params, buckets=buckets, warm=warm)
+        return cls(net, params, buckets=buckets, warm=warm, device=device)

@@ -26,7 +26,7 @@ from ..runtime.ckpt_files import latest_snapshot
 from ..runtime.metrics import log
 from .executor import load_serving_params
 
-__all__ = ["CheckpointReloader"]
+__all__ = ["CheckpointReloader", "FleetReloader"]
 
 
 class CheckpointReloader:
@@ -118,3 +118,72 @@ class CheckpointReloader:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+
+
+class FleetReloader(CheckpointReloader):
+    """The single-executor reloader generalized to drive a fleet: one
+    snapshot discovery, one load, then :meth:`ReplicaManager.rolling_reload`
+    drains and swaps replicas one at a time (never more than one draining;
+    zero requests dropped or errored across a full fleet reload).
+
+    Same watch loop, discovery rules (tmp-litter-proof, strictly-newer
+    only), failure accounting, and server `reload`-op surface as the
+    parent — ``reloads`` counts completed FLEET rolls; per-replica
+    generations are in the manager's stats rows."""
+
+    def __init__(self, manager, prefix: str, poll_s: float = 1.0,
+                 start: bool = True, current_path: Optional[str] = None,
+                 drain_timeout_s: Optional[float] = None):
+        self.manager = manager
+        self.drain_timeout_s = drain_timeout_s
+        super().__init__(executor=None, prefix=prefix, poll_s=poll_s,
+                         start=start, current_path=current_path)
+
+    def check_now(self) -> bool:
+        """One poll: if a strictly newer snapshot exists, load it ONCE
+        (against the fleet's reference replica) and roll it through every
+        serving replica. True iff a fleet roll completed cleanly."""
+        with self._lock:
+            path = latest_snapshot(self.prefix)
+            if path is None or path == self.current_path:
+                return False
+            if self.current_path is not None and \
+                    self._iter_of(path) <= self._iter_of(self.current_path):
+                return False
+            from .fleet import PartialReloadError
+            try:
+                ref = self.manager.reference_executor()
+                params = load_serving_params(ref.net, ref._params, path)
+                swapped = self.manager.rolling_reload(
+                    params, drain_timeout_s=self.drain_timeout_s)
+            except PartialReloadError as e:
+                # the roll RAN: some replicas landed, the rest refused or
+                # could not drain. Advance current_path anyway — retrying
+                # every poll would re-drain the healthy replicas (capacity
+                # dips) and stall drain_timeout_s per pass on the sick one
+                # forever. The skew is visible per-replica in stats; the
+                # next strictly-newer snapshot rolls again.
+                self.current_path = path
+                self.failed_reloads += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                log(f"serving: fleet reload of {os.path.basename(path)} "
+                    f"partially landed ({e.swapped} swapped, "
+                    f"{len(e.errors)} failed); not re-rolling until a "
+                    f"newer snapshot appears")
+                return False
+            except Exception as e:  # noqa: BLE001 — keep serving old params
+                # the LOAD failed (torn/incompatible snapshot): nothing
+                # was drained or swapped, so retrying next poll is free —
+                # the single-executor reloader's existing behavior
+                self.failed_reloads += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                log(f"serving: fleet reload of {os.path.basename(path)} "
+                    f"failed ({self.last_error}); replicas keep their "
+                    f"current params")
+                return False
+            self.current_path = path
+            self.reloads += 1
+            self.last_error = None
+            log(f"serving: fleet hot-reloaded {os.path.basename(path)} "
+                f"({swapped} replicas, one drain at a time)")
+            return True

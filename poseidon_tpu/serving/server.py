@@ -43,18 +43,36 @@ __all__ = ["InferenceServer"]
 
 
 class InferenceServer:
-    """Serve a :class:`BucketedExecutor` over TCP (port 0 = ephemeral)."""
+    """Serve a :class:`BucketedExecutor` — or a whole
+    :class:`~poseidon_tpu.serving.fleet.ReplicaManager` — over TCP
+    (port 0 = ephemeral).
 
-    def __init__(self, executor, host: str = "127.0.0.1", port: int = 0,
+    Exactly one of ``executor`` / ``fleet`` must be given. The executor
+    form is the PR-2 single-engine path (one private micro-batcher built
+    from ``max_delay_s``/``max_queue``); the fleet form routes every
+    request through the manager's least-loaded router instead — there the
+    batching/admission knobs live on each REPLICA's batcher (configured
+    when the fleet was built) and this constructor's ``max_delay_s``/
+    ``max_queue`` are unused — and the `stats` op becomes the fleet
+    health surface (per-replica rows). ``stats_refresh_s > 0`` refreshes
+    the StatsRegistry "serving" section on a timer so a live metrics
+    endpoint shows health without anyone calling the stats op."""
+
+    def __init__(self, executor=None, host: str = "127.0.0.1", port: int = 0,
                  max_delay_s: float = 0.005, max_queue: int = 64,
                  default_deadline_s: Optional[float] = None,
-                 reloader=None, stats: Optional[StatsRegistry] = None):
+                 reloader=None, stats: Optional[StatsRegistry] = None,
+                 fleet=None, stats_refresh_s: float = 0.0):
+        if (executor is None) == (fleet is None):
+            raise ValueError("pass exactly one of executor= or fleet=")
         self.executor = executor
+        self.fleet = fleet
         self.reloader = reloader
         self.stats = stats or StatsRegistry()
         self.default_deadline_s = default_deadline_s
-        self.batcher = DynamicBatcher(executor, max_delay_s=max_delay_s,
-                                      max_queue=max_queue)
+        self.batcher = (None if fleet is not None else
+                        DynamicBatcher(executor, max_delay_s=max_delay_s,
+                                       max_queue=max_queue))
         self.bad_frames = 0
         self.server_errors = 0
         self.connections = 0
@@ -72,6 +90,20 @@ class InferenceServer:
                                                daemon=True)
         self._accept_thread.start()
         self._started = time.time()
+        self._stats_refresh_s = float(stats_refresh_s)
+        if self._stats_refresh_s > 0:
+            threading.Thread(target=self._stats_refresh_loop,
+                             daemon=True).start()
+
+    def _stats_refresh_loop(self) -> None:
+        """Keep the StatsRegistry "serving" section current for the live
+        metrics endpoint — fleet health must be visible without a client
+        calling the stats op."""
+        while not self._stop.wait(self._stats_refresh_s):
+            try:
+                self.stats_snapshot()
+            except Exception:  # noqa: BLE001 — telemetry never kills serving
+                pass
 
     # ---- accept/handle --------------------------------------------------- #
     def _accept_loop(self) -> None:
@@ -151,16 +183,24 @@ class InferenceServer:
         if kind == "stats":
             return {"ok": True, "stats": self.stats_snapshot()}
         if kind == "health":
+            if self.fleet is not None:
+                return {"ok": True, "draining": self.draining,
+                        "states": self.fleet.state_counts(),
+                        "reload_generation": self.fleet.reload_generation}
             return {"ok": True, "draining": self.draining,
                     "params_version": self.executor.params_version}
         if kind == "reload":
             if self.reloader is None:
                 return {"ok": False, "error": "no reloader attached"}
             reloaded = self.reloader.check_now()
-            return {"ok": True, "reloaded": reloaded,
-                    "params_version": self.executor.params_version,
-                    "path": self.reloader.current_path,
-                    "last_error": self.reloader.last_error}
+            reply = {"ok": True, "reloaded": reloaded,
+                     "path": self.reloader.current_path,
+                     "last_error": self.reloader.last_error}
+            if self.fleet is not None:
+                reply["reload_generation"] = self.fleet.reload_generation
+            else:
+                reply["params_version"] = self.executor.params_version
+            return reply
         if kind == "bye":
             return None
         raise ValueError(f"unknown request kind {kind!r}")
@@ -170,6 +210,12 @@ class InferenceServer:
         deadline_s = (float(deadline_ms) / 1e3 if deadline_ms is not None
                       else self.default_deadline_s)
         try:
+            if self.fleet is not None:
+                outputs, rep = self.fleet.submit(msg["inputs"],
+                                                 deadline_s=deadline_s)
+                return {"ok": True, "outputs": outputs,
+                        "replica": rep.index,
+                        "params_version": rep.executor.params_version}
             outputs = self.batcher.submit(msg["inputs"],
                                           deadline_s=deadline_s)
             return {"ok": True, "outputs": outputs,
@@ -185,7 +231,29 @@ class InferenceServer:
     def stats_snapshot(self) -> Dict:
         """The `/stats` payload: p50/p99 request latency, queue depth,
         batch-fill ratio, shed count — registered as a StatsRegistry
-        section too, so a run-level stats.yaml dump carries it."""
+        section too, so a run-level stats.yaml dump carries it. With a
+        fleet, the payload is the manager's aggregate plus one row per
+        replica (state, queue depth, batch fill, sheds, reload
+        generation) — the fleet health surface."""
+        if self.fleet is not None:
+            snap = self.fleet.stats_snapshot()
+            snap.update({
+                "bad_frames": self.bad_frames,
+                "server_errors": self.server_errors,
+                "connections": self.connections,
+                "uptime_s": round(time.time() - self._started, 3),
+                "draining": self.draining,
+                "reloads": (0 if self.reloader is None
+                            else self.reloader.reloads),
+                "reloader": (None if self.reloader is None else {
+                    "reloads": self.reloader.reloads,
+                    "failed_reloads": self.reloader.failed_reloads,
+                    "last_error": self.reloader.last_error,
+                    "current_path": self.reloader.current_path,
+                }),
+            })
+            self.stats.set_section("serving", snap)
+            return snap
         b = self.batcher
         fill = b.fill_ratio()
         snap = {
@@ -257,7 +325,10 @@ class InferenceServer:
             self.reloader.close()
         # drain: every admitted request completes and its handler thread
         # writes the reply before we declare the server down
-        self.batcher.close(drain=drain, timeout_s=timeout_s)
+        if self.fleet is not None:
+            self.fleet.shutdown(drain=drain, timeout_s=timeout_s)
+        else:
+            self.batcher.close(drain=drain, timeout_s=timeout_s)
         # the batcher completing a request only SETS its event; the handler
         # thread still has to wake and write the reply frame — wait for
         # every received-but-unreplied request to hit the wire, or the

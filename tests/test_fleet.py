@@ -1,0 +1,769 @@
+"""Serving-fleet chaos suite (ISSUE 13): least-loaded routing, replica
+health states and failover, rolling hot-reload, per-replica stats, the
+open-loop load generator, and device pinning.
+
+Everything is CPU-safe, port 0 on loopback only, daemon threads only.
+Deterministic where it matters: routing units drive duck-typed fake
+executors (no timing races); the kill-1-of-3 acceptance scenario runs
+real sockets through runtime/faults.py's proxy and asserts the invariant
+(zero accepted requests lost — only explicit sheds), not a schedule.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serving
+
+DEPLOY_NET = """
+name: "fleetnet"
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 8 input_dim: 8
+layers { name: "conv" type: CONVOLUTION bottom: "data" top: "conv"
+  convolution_param { num_output: 4 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layers { name: "fc" type: INNER_PRODUCT bottom: "conv" top: "fc"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layers { name: "prob" type: SOFTMAX bottom: "fc" top: "prob" }
+"""
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).randn(n, 3, 8, 8).astype(np.float32)
+
+
+def _build_executor(buckets=(1, 2, 4), device=None, seed=7):
+    import jax
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.proto.messages import load_net_from_string
+    from poseidon_tpu.serving.executor import BucketedExecutor
+
+    net = Net(load_net_from_string(DEPLOY_NET), "TEST")
+    params = net.init(jax.random.PRNGKey(seed))
+    return BucketedExecutor(net, params, buckets=buckets, device=device)
+
+
+class FakeExecutor:
+    """Duck-typed replica engine: optional per-call stall, a poison switch
+    (``die.set()`` -> every dispatch raises, the replica-death lever), and
+    a per-instance dispatch log."""
+
+    def __init__(self, max_batch=4, delay_s=0.0):
+        self.input_names = ["x"]
+        self.max_batch = max_batch
+        self.delay_s = delay_s
+        self.die = threading.Event()
+        self.gate = None          # optional Event the dispatch blocks on
+        self.rows_served = 0
+        self.params_version = 0
+        self.infers = 0
+
+    def infer(self, inputs):
+        if self.gate is not None:
+            self.gate.wait(10.0)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.die.is_set():
+            raise RuntimeError("device lost")
+        rows = int(np.shape(inputs["x"])[0])
+        self.rows_served += rows
+        self.infers += 1
+        return {"y": np.asarray(inputs["x"], np.float32) * 2.0}
+
+    def swap_params(self, new_params):
+        self.params_version += 1
+        return self.params_version
+
+
+def _fake_fleet(n=3, delay_s=0.0, **kw):
+    from poseidon_tpu.serving.fleet import ReplicaManager
+
+    exs = [FakeExecutor(delay_s=delay_s) for _ in range(n)]
+    return ReplicaManager(exs, **kw), exs
+
+
+# --------------------------------------------------------------------------- #
+# routing
+# --------------------------------------------------------------------------- #
+
+def test_least_loaded_routing_skews_to_idle_replica():
+    """With replica 0's flush thread held busy (queued work = nonzero
+    load), every subsequent request lands on an idle replica — the
+    routing signal actually routes."""
+    mgr, exs = _fake_fleet(3)
+    try:
+        exs[0].gate = threading.Event()      # replica 0 blocks in dispatch
+        blocker = threading.Thread(
+            target=lambda: mgr.replicas[0].batcher.submit(
+                {"x": np.ones((1, 2), np.float32)}),
+            daemon=True)
+        blocker.start()
+        deadline = time.monotonic() + 5.0
+        while mgr.replicas[0].load() == 0.0:
+            assert time.monotonic() < deadline, "blocker never dispatched"
+            time.sleep(0.002)
+        for i in range(20):
+            out, rep = mgr.submit({"x": np.full((1, 2), i, np.float32)})
+            assert rep.index != 0, "router sent work to the busy replica"
+            np.testing.assert_array_equal(out["y"], np.full((1, 2), 2.0 * i))
+        assert exs[1].infers + exs[2].infers == 20
+        with mgr.replicas[0]._lock:
+            assert mgr.replicas[0].routed == 0
+        exs[0].gate.set()
+        blocker.join(timeout=10.0)
+    finally:
+        for ex in exs:
+            if ex.gate is not None:
+                ex.gate.set()
+        mgr.shutdown()
+
+
+def test_routing_excludes_warming_draining_and_dead():
+    from poseidon_tpu.serving.batcher import ShedError
+    from poseidon_tpu.serving.fleet import DEAD, DRAINING, SERVING, WARMING
+
+    mgr, exs = _fake_fleet(3)
+    try:
+        mgr._transition(mgr.replicas[0], DRAINING, reason="test")
+        mgr._transition(mgr.replicas[2], DRAINING, reason="test")
+        for _ in range(5):
+            _, rep = mgr.submit({"x": np.ones((1, 2), np.float32)})
+            assert rep.index == 1
+        # no serving replica at all -> immediate explicit shed
+        mgr._transition(mgr.replicas[1], DRAINING, reason="test")
+        t0 = time.monotonic()
+        with pytest.raises(ShedError, match="no serving replica"):
+            mgr.submit({"x": np.ones((1, 2), np.float32)})
+        assert time.monotonic() - t0 < 0.5, "fleet shed must be immediate"
+        assert mgr.fleet_sheds == 1
+    finally:
+        mgr.shutdown()
+
+
+def test_full_fleet_queues_shed_explicitly():
+    """Every serving replica at queue capacity -> ShedError naming the
+    backpressure, not a hang and not a reroute loop."""
+    from poseidon_tpu.serving.batcher import ShedError
+    from poseidon_tpu.serving.fleet import ReplicaManager
+
+    exs = [FakeExecutor() for _ in range(2)]
+    for ex in exs:
+        ex.gate = threading.Event()          # hold both flush threads
+    mgr = ReplicaManager(exs, max_queue=1)
+    threads = []
+    try:
+        # one in-flight + one queued per replica = both queues full
+        for rep in mgr.replicas:
+            for _ in range(2):
+                t = threading.Thread(
+                    target=lambda rep=rep: rep.batcher.submit(
+                        {"x": np.ones((1, 2), np.float32)}),
+                    daemon=True)
+                t.start()
+                threads.append(t)
+                time.sleep(0.05)
+        with pytest.raises(ShedError, match="queue capacity"):
+            mgr.submit({"x": np.ones((1, 2), np.float32)})
+    finally:
+        for ex in exs:
+            ex.gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        mgr.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# failure detection + failover
+# --------------------------------------------------------------------------- #
+
+def test_replica_death_fails_over_without_losing_requests():
+    """Manager-level determinism: kill one replica's executor while its
+    queue holds work; every request still completes OK on a survivor
+    (fan-out error -> reroute), the replica is DEAD, and nothing sheds."""
+    from poseidon_tpu.serving.fleet import DEAD, SERVING
+
+    mgr, exs = _fake_fleet(2)
+    try:
+        exs[0].gate = threading.Event()
+        exs[0].die.set()                     # dies on its NEXT dispatch
+        results = []
+        errors = []
+
+        def one(i):
+            try:
+                results.append(mgr.submit(
+                    {"x": np.full((1, 2), i, np.float32)}))
+            except BaseException as e:  # noqa: BLE001 — the assertion
+                errors.append(e)
+
+        # first request routes to replica 0 (tie-break by index) and will
+        # find the poisoned executor once the gate opens
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)
+        exs[0].gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not errors, f"request lost to a replica death: {errors[0]}"
+        assert len(results) == 6
+        states = mgr.state_counts()
+        assert states[DEAD] == 1 and states[SERVING] == 1
+        assert mgr.failovers >= 1
+        assert mgr.replicas[0].death_reason and \
+            "device lost" in mgr.replicas[0].death_reason
+        # the dead replica never comes back into the routing set
+        for i in range(4):
+            _, rep = mgr.submit({"x": np.ones((1, 2), np.float32)})
+            assert rep.index == 1
+    finally:
+        for ex in exs:
+            if ex.gate is not None:
+                ex.gate.set()
+        mgr.shutdown()
+
+
+def test_kill_one_of_three_chaos_under_load():
+    """The acceptance scenario, through the real front door AND the fault
+    proxy: 3 replicas under sustained socket load, one replica dies
+    mid-run, then a full network partition (sever_all) on top. Zero
+    accepted requests are lost — every request either completes OK or is
+    an explicit shed — and p99 stays bounded through the failover."""
+    from poseidon_tpu.runtime.faults import FaultProxy
+    from poseidon_tpu.serving.client import run_load
+    from poseidon_tpu.serving.fleet import DEAD
+    from poseidon_tpu.serving.server import InferenceServer
+
+    mgr, exs = _fake_fleet(3, delay_s=0.002)
+    srv = InferenceServer(fleet=mgr)
+    proxy = FaultProxy(srv.addr)
+    try:
+        box = {}
+
+        def load():
+            box["result"] = run_load(
+                proxy.addr, lambda i: {"x": np.ones((2, 3), np.float32)},
+                n_requests=150, concurrency=6, retry_deadline_s=10.0)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        exs[0].die.set()                     # replica death mid-request
+        time.sleep(0.15)
+        proxy.sever_all()                    # partition every connection
+        t.join(timeout=60.0)
+        assert not t.is_alive(), "load generator wedged"
+        r = box["result"]
+        # the invariant: only explicit sheds are lost, nothing errors
+        assert r["error"] == 0 and r["deadline"] == 0, r
+        assert r["ok"] + r["shed"] == 150, r
+        assert r["ok"] > 0
+        assert r["p99_ms"] is not None and r["p99_ms"] < 5000.0
+        assert mgr.state_counts()[DEAD] == 1
+        assert mgr.deaths == 1 and mgr.failovers >= 1
+        # survivors carried the load
+        assert exs[1].infers + exs[2].infers > 0
+    finally:
+        proxy.close()
+        srv.shutdown()
+
+
+def test_failover_deadline_is_absolute_across_reroutes():
+    """A request's deadline never restarts on failover: with the only
+    survivor unable to answer inside the remaining budget, the reroute
+    surfaces DeadlineError instead of silently extending the contract."""
+    from poseidon_tpu.serving.batcher import DeadlineError
+
+    mgr, exs = _fake_fleet(2)
+    try:
+        exs[0].gate = threading.Event()
+        exs[0].die.set()
+        exs[1].gate = threading.Event()      # survivor can't answer either
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineError):
+            # replica 0 holds the request past the deadline, then dies;
+            # the reroute must see an exhausted budget, not a fresh one
+            threading.Timer(0.25, exs[0].gate.set).start()
+            mgr.submit({"x": np.ones((1, 2), np.float32)}, deadline_s=0.1)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        for ex in exs:
+            if ex.gate is not None:
+                ex.gate.set()
+        mgr.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# rolling hot-reload
+# --------------------------------------------------------------------------- #
+
+def test_rolling_reload_invariant_under_load():
+    """A full fleet reload under live socket load: at most ONE replica
+    draining at any instant, zero request failures, every replica on the
+    new params and generation afterwards — and results actually flip."""
+    import jax
+
+    from poseidon_tpu.serving.client import ServingClient
+    from poseidon_tpu.serving.fleet import DRAINING, ReplicaManager
+    from poseidon_tpu.serving.server import InferenceServer
+
+    exs = [_build_executor() for _ in range(3)]
+    transitions = []
+    tr_lock = threading.Lock()
+
+    def observer(index, old, new, reason):
+        with tr_lock:
+            transitions.append((index, old, new))
+
+    mgr = ReplicaManager(exs, on_transition=observer)
+    srv = InferenceServer(fleet=mgr)
+    x = _rows(2)
+    errors = []
+    stop = threading.Event()
+
+    def hammer():
+        from poseidon_tpu.serving.client import ServingClient as C
+        c = C(srv.addr)
+        try:
+            while not stop.is_set():
+                try:
+                    c.infer({"data": x})
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    errors.append(e)
+                    return
+        finally:
+            c.close()
+
+    cli = ServingClient(srv.addr)
+    try:
+        before = cli.infer({"data": x})["prob"]
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        doubled = jax.tree_util.tree_map(lambda v: v * 2.0, exs[0]._params)
+        swapped = mgr.rolling_reload(doubled)
+        after = cli.infer({"data": x})["prob"]
+        time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not errors, \
+            f"request failed during rolling reload: {errors[0]}"
+        assert swapped == 3
+        assert mgr.max_concurrent_draining == 1, \
+            "more than one replica was draining at once"
+        # the transition log agrees: DRAINING entries never overlap
+        draining = 0
+        for _, old, new in transitions:
+            if new == DRAINING:
+                draining += 1
+                assert draining <= 1
+            elif old == DRAINING:
+                draining -= 1
+        assert not np.allclose(before, after)
+        for rep in mgr.replicas:
+            assert rep.reload_generation == 1
+            assert rep.executor.params_version == 1
+    finally:
+        stop.set()
+        cli.close()
+        srv.shutdown()
+
+
+def _snapshot_params(prefix, net, params, it):
+    import jax.numpy as jnp
+    from poseidon_tpu.parallel.trainer import init_train_state
+    from poseidon_tpu.runtime.checkpoint import snapshot
+
+    state = init_train_state(params)
+    state = state._replace(solver=state.solver._replace(
+        it=jnp.asarray(it, jnp.int32)))
+    return snapshot(prefix, net, params, state)
+
+
+def test_fleet_reloader_rolls_snapshot_through_every_replica(tmp_path):
+    """FleetReloader = the single-executor reloader's discovery rules +
+    ONE load + rolling_reload: all replicas land on the new snapshot, a
+    stale snapshot is a no-op, and the server `reload` op drives it."""
+    import jax
+
+    from poseidon_tpu.serving.client import ServingClient
+    from poseidon_tpu.serving.fleet import ReplicaManager
+    from poseidon_tpu.serving.reloader import FleetReloader
+    from poseidon_tpu.serving.server import InferenceServer
+
+    exs = [_build_executor(buckets=(1, 2)) for _ in range(3)]
+    mgr = ReplicaManager(exs)
+    prefix = str(tmp_path / "snap" / "fleetnet")
+    _, seed_path = _snapshot_params(prefix, exs[0].net, exs[0]._params, it=1)
+    rel = FleetReloader(mgr, prefix, start=False, current_path=seed_path)
+    assert rel.check_now() is False          # nothing newer than the seed
+    srv = InferenceServer(fleet=mgr, reloader=rel)
+    cli = ServingClient(srv.addr)
+    try:
+        doubled = jax.tree_util.tree_map(lambda v: v * 2.0, exs[0]._params)
+        _snapshot_params(prefix, exs[0].net, doubled, it=5)
+        reply = cli.reload()
+        assert reply["ok"] and reply["reloaded"] is True
+        assert reply["reload_generation"] == 1
+        assert rel.reloads == 1
+        for rep in mgr.replicas:
+            assert rep.executor.params_version == 1
+            assert rep.reload_generation == 1
+        # an OLDER snapshot later must not roll the fleet backwards
+        _snapshot_params(prefix, exs[0].net, exs[0]._params, it=3)
+        assert rel.check_now() is False and rel.reloads == 1
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_partial_reload_raises_typed_error_with_swapped_count():
+    """A replica that cannot drain inside the timeout keeps its old params
+    and the pass surfaces PartialReloadError (typed: the fleet reloader
+    advances past it instead of re-draining healthy replicas every poll);
+    the healthy replica still swapped."""
+    from poseidon_tpu.serving.fleet import PartialReloadError, SERVING
+
+    mgr, exs = _fake_fleet(2)
+    try:
+        exs[0].gate = threading.Event()      # replica 0 can never drain
+        blocker = threading.Thread(
+            target=lambda: mgr.replicas[0].batcher.submit(
+                {"x": np.ones((1, 2), np.float32)}),
+            daemon=True)
+        blocker.start()
+        deadline = time.monotonic() + 5.0
+        while mgr.replicas[0].load() == 0.0:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        with pytest.raises(PartialReloadError) as ei:
+            mgr.rolling_reload({"w": np.zeros(1, np.float32)},
+                               drain_timeout_s=0.1)
+        assert ei.value.swapped == 1 and len(ei.value.errors) == 1
+        assert exs[0].params_version == 0    # wedged: old params kept
+        assert exs[1].params_version == 1
+        with mgr.replicas[0]._lock:
+            assert mgr.replicas[0].state == SERVING   # back in the set
+        exs[0].gate.set()
+        blocker.join(timeout=10.0)
+    finally:
+        for ex in exs:
+            if ex.gate is not None:
+                ex.gate.set()
+        mgr.shutdown()
+
+
+def test_rolling_reload_skips_dead_replicas():
+    from poseidon_tpu.serving.fleet import DEAD
+
+    mgr, exs = _fake_fleet(3)
+    try:
+        mgr._mark_dead(mgr.replicas[1], "test kill")
+        swapped = mgr.rolling_reload({"w": np.zeros(1, np.float32)})
+        assert swapped == 2
+        assert exs[0].params_version == 1 and exs[2].params_version == 1
+        assert exs[1].params_version == 0    # dead replicas never reload
+        with mgr.replicas[1]._lock:
+            assert mgr.replicas[1].state == DEAD
+    finally:
+        mgr.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# warming
+# --------------------------------------------------------------------------- #
+
+def test_build_warms_replicas_through_warming_state():
+    """ReplicaManager.build with a gated factory: the fleet sheds while
+    every replica is WARMING and serves the moment one lands."""
+    from poseidon_tpu.serving.batcher import ShedError
+    from poseidon_tpu.serving.fleet import ReplicaManager, SERVING, WARMING
+
+    release = threading.Event()
+
+    def factory(device):
+        release.wait(10.0)
+        return FakeExecutor()
+
+    mgr = ReplicaManager.build(factory, 2, warm_async=True)
+    try:
+        assert mgr.state_counts()[WARMING] == 2
+        with pytest.raises(ShedError, match="no serving replica"):
+            mgr.submit({"x": np.ones((1, 2), np.float32)})
+        release.set()
+        deadline = time.monotonic() + 10.0
+        while mgr.state_counts()[SERVING] < 2:
+            assert time.monotonic() < deadline, "replicas never warmed"
+            time.sleep(0.01)
+        out, _ = mgr.submit({"x": np.ones((1, 2), np.float32)})
+        assert out["y"].shape == (1, 2)
+    finally:
+        release.set()
+        mgr.shutdown()
+
+
+def test_late_warming_replica_catches_up_to_rolled_params():
+    """warm_async + a reload landing while a replica is still compiling:
+    the late replica must come up on the ROLLED params (same generation),
+    never its stale factory weights."""
+    from poseidon_tpu.serving.fleet import ReplicaManager, SERVING
+
+    release = threading.Event()
+    slow_ex = FakeExecutor()
+
+    def factory(device):
+        if factory.first:
+            factory.first = False
+            return FakeExecutor()
+        release.wait(10.0)                   # replica 1 warms slowly
+        return slow_ex
+
+    factory.first = True
+    mgr = ReplicaManager.build(factory, 2, warm_async=True)
+    try:
+        deadline = time.monotonic() + 10.0
+        while mgr.state_counts()[SERVING] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        mgr.rolling_reload({"w": np.ones(1, np.float32)})
+        assert mgr.replicas[0].reload_generation == 1
+        release.set()                        # replica 1 warms AFTER the roll
+        deadline = time.monotonic() + 10.0
+        while slow_ex.params_version < 1:
+            assert time.monotonic() < deadline, \
+                "late replica never caught up to the rolled params"
+            time.sleep(0.01)
+        deadline = time.monotonic() + 10.0
+        while mgr.replicas[1].reload_generation < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+    finally:
+        release.set()
+        mgr.shutdown()
+
+
+def test_failed_warmup_is_a_dead_replica_not_a_dead_fleet():
+    from poseidon_tpu.serving.fleet import DEAD, ReplicaManager, SERVING
+
+    calls = {"n": 0}
+
+    def factory(device):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("OOM during bucket warm-up")
+        return FakeExecutor()
+
+    mgr = ReplicaManager.build(factory, 2)
+    try:
+        states = mgr.state_counts()
+        assert states[DEAD] == 1 and states[SERVING] == 1
+        out, rep = mgr.submit({"x": np.ones((1, 2), np.float32)})
+        assert out["y"].shape == (1, 2) and rep.index == 1
+    finally:
+        mgr.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# stats surface
+# --------------------------------------------------------------------------- #
+
+def test_fleet_stats_has_per_replica_rows_and_aggregates():
+    from poseidon_tpu.serving.client import ServingClient
+    from poseidon_tpu.serving.server import InferenceServer
+
+    mgr, exs = _fake_fleet(3)
+    srv = InferenceServer(fleet=mgr)
+    cli = ServingClient(srv.addr)
+    try:
+        for i in range(6):
+            cli.infer({"x": np.ones((1, 2), np.float32)})
+        st = cli.stats()
+        assert st["n_replicas"] == 3
+        assert set(st["replicas"]) == {"0", "1", "2"}
+        for row in st["replicas"].values():
+            for key in ("state", "queue_depth", "batch_fill", "shed",
+                        "reload_generation", "load", "routed", "failures",
+                        "latency"):
+                assert key in row, f"replica row missing {key}"
+        assert sum(r["routed"] for r in st["replicas"].values()) == 6
+        for key in ("states", "routing", "latency", "replica_latency",
+                    "reload_generation", "max_concurrent_draining",
+                    "deaths", "bad_frames", "connections", "uptime_s"):
+            assert key in st, f"fleet stats missing {key}"
+        assert st["routing"]["routed"] == 6
+        h = cli.health()
+        assert h["ok"] and h["states"]["SERVING"] == 3
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_fleet_stats_flatten_on_metrics_endpoint():
+    """The per-replica rows render as replicas.<i>.<key>=... on the live
+    metrics endpoint — the fleet health surface is one curl away."""
+    import urllib.request
+
+    from poseidon_tpu.runtime.metrics import MetricsServer
+    from poseidon_tpu.serving.server import InferenceServer
+
+    mgr, _ = _fake_fleet(2)
+    srv = InferenceServer(fleet=mgr)
+    msrv = MetricsServer(srv.stats, port=0)
+    try:
+        mgr.submit({"x": np.ones((1, 2), np.float32)})
+        srv.stats_snapshot()                 # refresh the registry section
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{msrv.port}/", timeout=5.0).read().decode()
+        assert "serving.replicas.0.queue_depth=" in body
+        assert "serving.routing.routed=1" in body
+    finally:
+        msrv.close()
+        srv.shutdown()
+
+
+def test_server_requires_exactly_one_backend():
+    from poseidon_tpu.serving.server import InferenceServer
+
+    with pytest.raises(ValueError, match="exactly one"):
+        InferenceServer()
+    mgr, _ = _fake_fleet(1)
+    try:
+        with pytest.raises(ValueError, match="exactly one"):
+            InferenceServer(executor=FakeExecutor(), fleet=mgr)
+    finally:
+        mgr.shutdown()
+
+
+def test_merged_latency_summary_pools_windows():
+    from poseidon_tpu.runtime.metrics import LatencyWindow
+
+    a, b = LatencyWindow(), LatencyWindow()
+    for v in (0.010, 0.020, 0.030):
+        a.record(v)
+    b.record(0.100)
+    merged = LatencyWindow.merged_summary([a, b])
+    assert merged["count"] == 4
+    assert merged["p50_ms"] == pytest.approx(20.0, abs=10.001)
+    assert merged["p99_ms"] == pytest.approx(100.0)
+    assert LatencyWindow.merged_summary([]) == {"count": 0}
+
+
+# --------------------------------------------------------------------------- #
+# open-loop load generator
+# --------------------------------------------------------------------------- #
+
+def test_open_loop_load_generator_paces_offered_rate():
+    """offered_rps fixes ARRIVALS: 30 requests at 100 req/s take ~0.3 s of
+    wall clock even though the (fast) server could absorb them instantly —
+    the opposite of closed-loop self-throttling — and the result carries
+    the goodput/late-fire fields the fleet curves are built from."""
+    from poseidon_tpu.serving.client import run_load
+    from poseidon_tpu.serving.server import InferenceServer
+
+    srv = InferenceServer(executor=FakeExecutor(), max_delay_s=0.0)
+    try:
+        r = run_load(srv.addr, lambda i: {"x": np.ones((1, 2), np.float32)},
+                     n_requests=30, concurrency=8, offered_rps=100.0)
+        assert r["ok"] == 30 and r["error"] == 0
+        assert r["offered_rps"] == 100.0
+        assert r["wall_s"] >= 0.25, \
+            "open loop did not pace arrivals (closed-loop blast?)"
+        assert "late_fires" in r and "achieved_rps" in r
+        assert r["goodput_rps"] <= 130.0
+        # closed loop on the same server: no pacing fields
+        r2 = run_load(srv.addr,
+                      lambda i: {"x": np.ones((1, 2), np.float32)},
+                      n_requests=20, concurrency=4)
+        assert "offered_rps" not in r2 and r2["goodput_rps"] > 0
+        # a zero rate is refused loudly, never a silent worker death
+        with pytest.raises(ValueError, match="offered_rps"):
+            run_load(srv.addr,
+                     lambda i: {"x": np.ones((1, 2), np.float32)},
+                     n_requests=5, offered_rps=0.0)
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# device pinning + CLI fleet builder
+# --------------------------------------------------------------------------- #
+
+def test_executor_device_pinning_places_params_and_matches():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 2, "conftest should provide the 8-device CPU mesh"
+    pinned = _build_executor(device=devs[1])
+    free = _build_executor()
+    leaf = jax.tree_util.tree_leaves(pinned._params)[0]
+    assert leaf.devices() == {devs[1]}
+    x = _rows(2)
+    np.testing.assert_array_equal(pinned.infer({"data": x})["prob"],
+                                  free.infer({"data": x})["prob"])
+    # a swap lands the new tree on the pinned device too
+    import jax.numpy as jnp
+    pinned.swap_params(jax.tree_util.tree_map(lambda v: v * 2.0,
+                                              free._params))
+    leaf = jax.tree_util.tree_leaves(pinned._params)[0]
+    assert leaf.devices() == {devs[1]}
+
+
+def test_build_serving_fleet_pins_round_robin_and_validates(tmp_path):
+    import jax
+
+    from poseidon_tpu.runtime.cli import (_resolve_fleet_devices,
+                                          build_serving_fleet)
+
+    devs = jax.devices()
+    picked = _resolve_fleet_devices("0,2", 2)
+    assert picked == [devs[0], devs[2]]
+    with pytest.raises(SystemExit, match="no such device index"):
+        _resolve_fleet_devices("99", 2)
+    with pytest.raises(SystemExit, match="comma-separated"):
+        _resolve_fleet_devices("a,b", 2)
+    assert _resolve_fleet_devices("", 1) == []
+
+    model = tmp_path / "deploy.prototxt"
+    model.write_text(DEPLOY_NET)
+    mgr = build_serving_fleet(str(model), "", "1,2", 3,
+                              devices_spec="0,1")
+    try:
+        assert len(mgr.replicas) == 3
+        labels = [rep.device_label for rep in mgr.replicas]
+        assert labels[0] == labels[2] == str(devs[0])   # round-robin
+        assert labels[1] == str(devs[1])
+        for rep in mgr.replicas:
+            leaf = jax.tree_util.tree_leaves(rep.executor._params)[0]
+            assert str(next(iter(leaf.devices()))) == rep.device_label
+        out, _ = mgr.submit({"data": _rows(2)})
+        assert out["prob"].shape == (2, 3)
+    finally:
+        mgr.shutdown()
+
+
+def test_fleet_roundtrip_reports_serving_replica():
+    """The wire reply names which replica served — the client-visible
+    half of the routing story."""
+    from poseidon_tpu.proto.wire import recv_frame, send_frame
+    import socket as _socket
+
+    from poseidon_tpu.serving.server import InferenceServer
+
+    mgr, _ = _fake_fleet(2)
+    srv = InferenceServer(fleet=mgr)
+    try:
+        sk = _socket.create_connection(srv.addr)
+        send_frame(sk, {"kind": "infer",
+                        "inputs": {"x": np.ones((1, 2), np.float32)}})
+        reply = recv_frame(sk)
+        assert reply["ok"] is True
+        assert reply["replica"] in (0, 1)
+        sk.close()
+    finally:
+        srv.shutdown()
