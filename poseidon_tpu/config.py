@@ -228,7 +228,13 @@ class PipelineConfig:
     device-side input prefetch, a bounded in-flight dispatch window, and
     background snapshot serialization. All three are numerics-neutral:
     the dispatched step sequence is identical, only host blocking moves
-    (tests/test_pipeline_overlap.py pins bitwise parity)."""
+    (tests/test_pipeline_overlap.py pins bitwise parity).
+
+    The dataclass defaults here are one row of the collapsed policy
+    surface: ``runtime/tuned_plan.BUILTIN_DEFAULTS`` reads them, a
+    persisted TunedPlan's measured winners replace them at CLI startup,
+    and an explicit flag overrides both (resolution provenance lands in
+    stats.yaml)."""
 
     # host batches staged to device AHEAD of the step that consumes them
     # (data.pipeline.DevicePrefetcher depth); 0 disables the stage and the

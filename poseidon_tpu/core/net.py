@@ -94,9 +94,11 @@ class Net:
         self.name = net_param.name
         # The layout is a GRAPH-level choice, fixed at construction: the
         # per-net override wins, else the ambient numeric policy's default.
-        # "auto" resolves per-backend here (NCHW on TPU — the NHWC plan
-        # measured 0.53x on the real v5e despite winning the transpose
-        # count; NHWC where it wins — see numeric.resolve_conv_layout).
+        # "auto" resolves through plan resolution (runtime/tuned_plan.py):
+        # an active TunedPlan's MEASURED conv_layout row answers first;
+        # without a plan the builtin per-backend table applies (NCHW on
+        # TPU — the NHWC plan measured 0.53x on the real v5e despite
+        # winning the transpose count; see numeric.resolve_conv_layout).
         # (Ops take explicit layout args; they no longer read the policy.)
         from ..numeric import resolve_conv_layout
         self.conv_layout = resolve_conv_layout(

@@ -70,17 +70,23 @@ BF16_SMOKE_RTOL = 0.10
 BF16_SMOKE_ATOL = 0.05
 
 
-def resolve_conv_layout(layout: str, backend: str = None) -> str:
+def resolve_conv_layout(layout: str, backend: str = None,
+                        consult_plan: bool = True) -> str:
     """Resolve a conv_layout choice ("NCHW" | "NHWC" | "auto") against the
     backend actually running the net.
 
-    "auto" picks the layout the measured A/B favors per backend:
+    "auto" first consults the active :mod:`runtime.tuned_plan` resolution:
+    when a measured TunedPlan is loaded for this run, its conv_layout
+    winner IS the auto answer — the per-backend table below became one
+    measured row of the plan (ROADMAP item 5). Without a plan (or with
+    ``consult_plan=False`` — the tune search uses this to build the
+    default arm) auto falls back to the built-in table:
 
     - **tpu**: NCHW. The NHWC plan wins the HLO-transpose count (exactly
       the fc-boundary pair) but MEASURED 0.53x on the real v5e
       (``nhwc_speedup`` in BENCH_r05) — the TPU compiler's own layout
       assignment beats our forced channels-last plan for these nets, so
-      auto stays NCHW until the bench A/B shows >= 1.0.
+      auto stays NCHW until a measured plan shows >= 1.0.
     - **gpu**: NHWC (tensor-core native conv layout).
     - **cpu** (and anything unknown): NCHW — the Caffe-parity default the
       golden-value suites run under.
@@ -89,6 +95,11 @@ def resolve_conv_layout(layout: str, backend: str = None) -> str:
     lay = (layout or "NCHW").upper()
     if lay != "AUTO":
         return lay
+    if consult_plan:
+        from .runtime.tuned_plan import active_plan_value
+        measured = active_plan_value("conv_layout")
+        if measured:
+            return str(measured).upper()
     if backend is None:
         import jax
         backend = jax.default_backend()

@@ -15,6 +15,7 @@ feature extractor.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time as _time
 from typing import List, Optional
@@ -38,7 +39,11 @@ def _engine_from_args(args, phase_nets=True):
     from .engine import Engine
 
     import dataclasses
-    sp = load_solver(args.solver)
+    sp = getattr(args, "_loaded_solver", None) or load_solver(args.solver)
+    # sentinel None = "no explicit flag": the TunedPlan resolution in
+    # cmd_train already replaced these with plan/default values; a direct
+    # _engine_from_args caller (tests) gets the built-in defaults
+    arena_mb = getattr(args, "arena_bucket_mb", None)
     comm = CommConfig(default_strategy=args.strategy,
                       reduce=args.grad_reduce,
                       topk_policy=getattr(args, "topk_policy", "magnitude"),
@@ -49,7 +54,7 @@ def _engine_from_args(args, phase_nets=True):
                           else args.dwbp_bucket_mb),
                       param_arena=(getattr(args, "param_arena", "true")
                                    == "true"),
-                      arena_bucket_mb=getattr(args, "arena_bucket_mb", 4.0),
+                      arena_bucket_mb=4.0 if arena_mb is None else arena_mb,
                       server_logic=getattr(args, "server_logic", "inc"),
                       adarev_init_step=getattr(args, "adarev_init_step", 0.1))
     if args.sfb_auto:
@@ -114,10 +119,11 @@ def _engine_from_args(args, phase_nets=True):
             async_cfg["comm_adaptive"] = True
         staleness = 0
     metrics_port = getattr(args, "metrics_port", -1)
+    spd = getattr(args, "steps_per_dispatch", None)
     return Engine(sp, comm=comm, mesh=mesh, mesh_cfg=mesh_cfg,
                   output_dir=args.output_dir,
                   staleness=staleness, sfb_auto=args.sfb_auto,
-                  steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
+                  steps_per_dispatch=1 if spd is None else spd,
                   device_transform=getattr(args, "device_transform", False),
                   async_ssp=async_cfg,
                   device_prefetch=getattr(args, "device_prefetch", None),
@@ -148,19 +154,72 @@ def _enable_compile_cache_from_args(args) -> None:
         f"(aot_steps={getattr(args, 'aot_steps', 'true')})")
 
 
+def _apply_tuned_plan_train(args) -> None:
+    """TunedPlan auto-load for cmd_train (runtime/tuned_plan.py): fold the
+    persisted plan for (train net, backend, n_devices) under the EXPLICIT
+    flags — flag > plan > built-in default, per knob — install the policy
+    (conv_layout / conv_strategy / pipeline config), publish the
+    resolution (the engine writes its provenance into stats.yaml), and
+    mutate the sentinel-defaulted args in place with the resolved values.
+    ``--tuned_plan off`` skips the store entirely (defaults + flags
+    only)."""
+    from .metrics import log
+    from .tuned_plan import (apply_training_resolution, load_plan, resolve,
+                             store_dir)
+
+    explicit = {}
+    if getattr(args, "conv_layout", ""):
+        explicit["conv_layout"] = args.conv_layout.upper()
+    if getattr(args, "conv_strategy", ""):
+        explicit["conv_strategy"] = args.conv_strategy
+    if getattr(args, "arena_bucket_mb", None) is not None:
+        explicit["arena_bucket_mb"] = args.arena_bucket_mb
+    if getattr(args, "mesh", ""):
+        explicit["mesh"] = args.mesh
+    if getattr(args, "device_prefetch", None) is not None:
+        explicit["device_prefetch"] = args.device_prefetch
+    if getattr(args, "max_in_flight", None) is not None:
+        explicit["max_in_flight"] = args.max_in_flight
+    if getattr(args, "steps_per_dispatch", None) is not None:
+        explicit["steps_per_dispatch"] = args.steps_per_dispatch
+
+    doc, store = None, ""
+    if getattr(args, "tuned_plan", "auto") != "off":
+        from ..proto.messages import load_solver
+        from .engine import resolve_nets
+        # parse once; _engine_from_args reuses the loaded SolverParameter
+        # instead of re-reading the solver + net prototxt from disk
+        args._loaded_solver = load_solver(args.solver)
+        train_param, _ = resolve_nets(args._loaded_solver)
+        model = (train_param.name or "net").lower()
+        store = store_dir()
+        doc = load_plan(model, cache_dir=store)
+        if doc is None:
+            log(f"[tuned_plan] no plan for {model!r} in {store}; "
+                f"built-in defaults apply (run `python -m poseidon_tpu "
+                f"tune --model ...` to measure one)")
+    res = resolve(doc, explicit, store=store)
+    knobs = apply_training_resolution(res)
+    log(f"[tuned_plan] {res.describe()}")
+    args.arena_bucket_mb = knobs["arena_bucket_mb"]
+    args.mesh = knobs["mesh"]
+    args.steps_per_dispatch = knobs["steps_per_dispatch"]
+    args.device_prefetch = knobs["device_prefetch"]
+    args.max_in_flight = knobs["max_in_flight"]
+
+
 def cmd_train(args) -> int:
     from .cluster import init_distributed
     _enable_compile_cache_from_args(args)
     if args.bf16:
         from .. import config
         config.set_perf_policy()
-    if getattr(args, "conv_strategy", ""):
-        # per-layer lowering-strategy axis: "auto" measures each conv
-        # layer at Net construction (choices logged + persisted through
-        # the compile-cache tuned store); concrete values force one
-        # strategy net-wide, overriding the legacy conv_s2d policy
-        from .. import config
-        config.set_policy(conv_strategy=args.conv_strategy)
+    # TunedPlan resolution replaces the old ad-hoc per-flag policy pokes:
+    # conv_strategy / conv_layout land in the numeric policy, the pipeline
+    # knobs in PipelineConfig, and the engine-level knobs back onto args —
+    # explicit flags always win, plan values fill the gaps, built-in
+    # defaults bat last, with every source recorded in stats.yaml
+    _apply_tuned_plan_train(args)
     if getattr(args, "async_ssp", False):
         # async-SSP: the processes stay INDEPENDENT jax runtimes — no
         # jax.distributed world, no collective rendezvous; the only
@@ -442,6 +501,37 @@ layers { name: "prob" type: SOFTMAX bottom: "fc" top: "prob" }
 """
 
 
+def _resolve_serve_buckets(args) -> str:
+    """The serving bucket ladder through TunedPlan resolution: an explicit
+    --buckets flag wins; else the persisted plan for the deploy net (keyed
+    like train's: net name, backend, n_devices) supplies its measured
+    ladder; else the built-in default. The source is logged so a serving
+    log always says where its ladder came from."""
+    from .metrics import log
+    from .tuned_plan import BUILTIN_DEFAULTS, load_plan
+
+    spec = getattr(args, "buckets", "")
+    if spec:
+        return spec
+    if getattr(args, "model", "") and \
+            getattr(args, "tuned_plan", "auto") != "off":
+        try:
+            from ..proto.messages import load_net
+            model_name = (load_net(args.model).name or "").lower()
+        except Exception as e:  # noqa: BLE001 — the executor build will
+            model_name = ""     # surface a real model problem loudly
+            log(f"[tuned_plan] could not read {args.model!r} for plan "
+                f"lookup ({type(e).__name__}: {e}); default ladder")
+        if model_name:
+            doc = load_plan(model_name)
+            ladder = (doc or {}).get("knobs", {}).get("serve_buckets")
+            if ladder:
+                log(f"[tuned_plan] serve_buckets={ladder} "
+                    f"(plan {str(doc.get('key', '?'))[:12]})")
+                return ladder
+    return BUILTIN_DEFAULTS["serve_buckets"]
+
+
 def _build_serving_executor(model: str, weights: str, buckets: str,
                             device=None):
     """Shared by serve/bench_serve: deploy net (or the built-in synthetic
@@ -527,6 +617,7 @@ def cmd_serve(args) -> int:
     # training tier pays: the persistent cache turns a restarted replica's
     # AOT bucket compiles into disk reads
     _enable_compile_cache_from_args(args)
+    args.buckets = _resolve_serve_buckets(args)
     watch = args.watch
     if watch == "auto":
         # derive the snapshot prefix from the weights path:
@@ -673,6 +764,7 @@ def cmd_bench_serve(args) -> int:
     import json
 
     _enable_compile_cache_from_args(args)
+    args.buckets = _resolve_serve_buckets(args)
     replicas = max(1, getattr(args, "replicas", 1))
     offered = (args.offered_rps if getattr(args, "offered_rps", 0) > 0
                else None)
@@ -717,6 +809,49 @@ def cmd_bench_serve(args) -> int:
     print(json.dumps({"metric": "serving_p99_ms",
                       "value": result["p99_ms"],
                       "unit": "ms", **result}), flush=True)
+    return 0
+
+
+def cmd_tune(args) -> int:
+    """The measured autotuner (runtime/tuned_plan.py, ROADMAP item 5):
+    short wall-clock trials over the whole policy space — conv_layout,
+    per-layer conv_strategy, arena_bucket_mb, mesh factorization, the
+    step-pipeline knobs, serving bucket rungs — persisted as ONE TunedPlan
+    with provenance next to the AOT executables. train/serve/bench_serve
+    auto-load the matching plan at startup; a second ``tune`` memo-hits
+    the store and skips re-measurement (--force re-tunes). Prints one
+    JSON summary line."""
+    import json
+
+    from .. import config
+    from .tuned_plan import run_tune
+
+    # the plan store rides the compile-cache dir when one is configured
+    # (plans live next to the executables they tuned); the store_dir()
+    # default covers the zero-flag tune -> train round trip
+    _enable_compile_cache_from_args(args)
+    cache_dir = (getattr(args, "compile_cache_dir", "")
+                 or config.compile_cache_config().cache_dir)
+    result = run_tune(args.model, smoke=args.smoke, force=args.force,
+                      cache_dir=cache_dir or None, deploy=args.deploy,
+                      windows=args.windows or None,
+                      iters=args.iters or None)
+    doc = result["doc"]
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        tmp = f"{args.out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, args.out)
+    print(json.dumps({
+        "metric": "tune", "model": doc["model"],
+        "backend": doc["backend"], "device_kind": doc["device_kind"],
+        "source": result["source"], "path": result["path"],
+        "knobs": doc["knobs"],
+        "search_cost_s": doc.get("search_cost_s"),
+        "tuned_vs_default_speedup": doc.get("ab", {}).get("speedup"),
+    }), flush=True)
     return 0
 
 
@@ -832,10 +967,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "run the optimizer update as one fused pass; same "
                         "numbers as the per-leaf path (update rule bitwise, "
                         "steps within 1 ulp of collective reduction order)")
-    t.add_argument("--arena_bucket_mb", type=float, default=4.0,
+    t.add_argument("--arena_bucket_mb", type=float, default=None,
                    help="arena gradient-sync bucket size in MB (DWBP-"
                         "ordered exact element ranges; <= 0 = one bucket "
-                        "per leaf)")
+                        "per leaf). Unset = TunedPlan value if one is "
+                        "persisted, else 4.0")
     t.add_argument("--bf16", action="store_true",
                    help="the documented bf16 training path: bfloat16 "
                         "compute (MXU-native) + the exact space-to-depth "
@@ -851,8 +987,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "(short micro-runs; winners logged and persisted "
                         "via --compile_cache_dir so the next run skips "
                         "re-measurement), a concrete value forces one "
-                        "strategy net-wide; empty = the legacy global "
+                        "strategy net-wide; empty = the TunedPlan value "
+                        "if one is persisted, else the legacy global "
                         "conv_s2d policy (on under --bf16)")
+    t.add_argument("--conv_layout", default="",
+                   type=lambda s: s.lower(),
+                   choices=["", "nchw", "nhwc", "auto"],
+                   help="internal activation layout for the whole graph "
+                        "(core/net.py plans conv/pool/LRN natively in it; "
+                        "checkpoints stay canonical NCHW). Unset = the "
+                        "TunedPlan's measured row if one is persisted, "
+                        "else 'auto' (the per-backend table in "
+                        "numeric.resolve_conv_layout)")
+    t.add_argument("--tuned_plan", default="auto", choices=["auto", "off"],
+                   help="TunedPlan auto-load (runtime/tuned_plan.py): "
+                        "'auto' loads the persisted plan matching (train "
+                        "net, backend, device kind, devices) and fills "
+                        "every knob no explicit flag set — provenance "
+                        "lands in stats.yaml; 'off' = built-in defaults "
+                        "+ flags only")
     t.add_argument("--mesh", default="",
                    help="named SPMD mesh spec, e.g. 'dp2,fsdp2,tp1' "
                         "(axes: dp = data parallel, fsdp = sharded "
@@ -937,11 +1090,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cluster hostfile ('<id> <ip> <port>' lines)")
     t.add_argument("--node_id", type=int, default=-1,
                    help="this process's hostfile id")
-    t.add_argument("--steps_per_dispatch", type=int, default=1,
+    t.add_argument("--steps_per_dispatch", type=int, default=None,
                    help="run K optimizer steps per compiled dispatch "
                         "(lax.scan): amortizes per-dispatch runtime "
                         "round-trip; falls back to single steps near "
-                        "display/test/snapshot boundaries")
+                        "display/test/snapshot boundaries (unset = "
+                        "TunedPlan value if persisted, else 1)")
     t.add_argument("--device_prefetch", type=int, default=None,
                    help="device-side input prefetch depth: a background "
                         "stage device_puts the next N host batches with "
@@ -1047,9 +1201,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "UNAUTHENTICATED — loopback/trusted networks only")
     sv.add_argument("--port", type=int, default=0,
                     help="0 = ephemeral (printed at startup)")
-    sv.add_argument("--buckets", default="1,4,16,64",
+    sv.add_argument("--buckets", default="",
                     help="batch bucket ladder; every bucket is AOT-"
-                         "compiled at startup (no trace on a request)")
+                         "compiled at startup (no trace on a request). "
+                         "Unset = the deploy net's TunedPlan ladder if "
+                         "one is persisted, else 1,4,16,64")
+    sv.add_argument("--tuned_plan", default="auto", choices=["auto", "off"],
+                    help="'auto' resolves an unset --buckets through the "
+                         "persisted TunedPlan; 'off' = built-in default")
     sv.add_argument("--max_delay_ms", type=float, default=5.0,
                     help="micro-batcher flush deadline: a queued request "
                          "never waits longer than this for batch company")
@@ -1085,7 +1244,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="deploy prototxt; empty uses a built-in synthetic "
                          "conv net")
     bs.add_argument("--weights", default="")
-    bs.add_argument("--buckets", default="1,4,16,64")
+    bs.add_argument("--buckets", default="",
+                    help="unset = TunedPlan ladder if persisted, else "
+                         "1,4,16,64")
+    bs.add_argument("--tuned_plan", default="auto",
+                    choices=["auto", "off"])
     bs.add_argument("--requests", type=int, default=200)
     bs.add_argument("--concurrency", type=int, default=4)
     bs.add_argument("--batch", type=int, default=8,
@@ -1103,6 +1266,42 @@ def build_parser() -> argparse.ArgumentParser:
                          "0 = closed loop")
     bs.add_argument("--compile_cache_dir", default="")
     bs.set_defaults(fn=cmd_bench_serve)
+
+    tu = sub.add_parser(
+        "tune", help="measured autotuner: short wall-clock trials over "
+                     "the policy space (conv layout/strategy, arena "
+                     "buckets, mesh, pipeline, serving rungs), persisted "
+                     "as ONE TunedPlan that train/serve auto-load")
+    tu.add_argument("--model", default="lenet",
+                    choices=["lenet", "alexnet", "googlenet"],
+                    help="tune target (plan keyed by the net's name, so "
+                         "a train run on the same model auto-loads it)")
+    tu.add_argument("--smoke", action="store_true",
+                    help="tier-1-safe smoke: tiny shapes, 2-point search "
+                         "spaces, spmd mesh arms skipped (recorded as "
+                         "only-candidate rows, never silently)")
+    tu.add_argument("--force", action="store_true",
+                    help="re-measure even when a matching plan is "
+                         "persisted (default: memo-hit and skip)")
+    tu.add_argument("--deploy", default="",
+                    help="deploy prototxt for the serving-ladder trials "
+                         "(default: a synthetic probe net, labeled)")
+    tu.add_argument("--windows", type=int, default=0,
+                    help="interleaved timing windows per knob (0 = 2 "
+                         "smoke / 4 full)")
+    tu.add_argument("--iters", type=int, default=0,
+                    help="timed calls per window (0 = 2 smoke / 4 full)")
+    tu.add_argument("--out", default="",
+                    help="also write the plan JSON here (evidence copy; "
+                         "the store copy always lands next to the AOT "
+                         "executables)")
+    tu.add_argument("--compile_cache_dir", default="",
+                    help="plan store override (default: the configured "
+                         "compile-cache dir, else POSEIDON_TUNED_DIR, "
+                         "else ~/.cache/poseidon_tpu)")
+    tu.add_argument("--aot_steps", default="true",
+                    choices=["true", "false"], help=argparse.SUPPRESS)
+    tu.set_defaults(fn=cmd_tune)
 
     ci = sub.add_parser("convert_imageset", help="image list -> LMDB")
     ci.add_argument("listfile")

@@ -223,13 +223,25 @@ def tuned_path(cache_dir: str, namespace: str, key: str) -> str:
 
 def load_tuned(cache_dir: str, namespace: str, key: str) -> Optional[Dict]:
     """The persisted decision document, or None on miss/any failure (a
-    torn or foreign entry degrades to a re-measure, never an abort)."""
+    torn or foreign entry degrades to a re-measure, never an abort — this
+    is called mid-Net-construction, where a raise would kill the run). A
+    clean miss (no file) is silent; a file that EXISTS but cannot be
+    parsed is logged loudly, because it means a writer died mid-write or
+    the store was hand-edited — the entry will be re-measured and
+    rewritten."""
     if not cache_dir:
         return None
+    path = tuned_path(cache_dir, namespace, key)
     try:
-        with open(tuned_path(cache_dir, namespace, key)) as f:
+        with open(path) as f:
             return json.load(f)
-    except (OSError, ValueError):
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        from .metrics import log
+        log(f"compile_cache: tuned entry {namespace}-{key} at {path} is "
+            f"torn/unreadable ({type(e).__name__}: {e}); treating as a "
+            f"miss — will re-measure and overwrite")
         return None
 
 

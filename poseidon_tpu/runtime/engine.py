@@ -142,6 +142,16 @@ class Engine:
         self.staleness = staleness
         self.output_dir = output_dir
         self.stats = StatsRegistry()
+        # TunedPlan provenance (runtime/tuned_plan.py): when the CLI
+        # resolved a plan for this run, stats.yaml carries every knob's
+        # value + source (flag/plan/default) and which measured winners an
+        # explicit flag overrode — a stats artifact always says what
+        # policy was in effect and why
+        from .tuned_plan import active_resolution
+        self._plan_resolution = active_resolution()
+        if self._plan_resolution is not None:
+            self.stats.set_section("tuned_plan",
+                                   self._plan_resolution.provenance())
         self.rank = jax.process_index()
         self.world = jax.process_count()
         # --- telemetry spine ------------------------------------------- #

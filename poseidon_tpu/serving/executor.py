@@ -28,7 +28,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-DEFAULT_BUCKETS = (1, 4, 16, 64)
+from ..runtime.tuned_plan import BUILTIN_DEFAULTS as _POLICY_DEFAULTS
+
+# The built-in bucket ladder is one row of the collapsed policy surface
+# (runtime/tuned_plan.BUILTIN_DEFAULTS["serve_buckets"]): a measured
+# TunedPlan replaces it at the CLI resolution layer (runtime/cli.py), an
+# explicit --buckets flag overrides both.
+DEFAULT_BUCKETS = tuple(
+    int(tok) for tok in _POLICY_DEFAULTS["serve_buckets"].split(","))
 
 
 def parse_buckets(spec: str) -> Tuple[int, ...]:

@@ -720,7 +720,14 @@ def test_cli_serve_parser_defaults():
     from poseidon_tpu.runtime.cli import build_parser
 
     args = build_parser().parse_args(["serve", "--model", "m.prototxt"])
-    assert args.buckets == "1,4,16,64" and args.port == 0
+    # unset --buckets is a TunedPlan sentinel; resolution falls back to
+    # the built-in ladder when no plan is persisted for the deploy net
+    assert args.buckets == "" and args.port == 0
+    from poseidon_tpu.runtime.cli import _resolve_serve_buckets
+    args.model = ""          # no deploy net -> no plan lookup
+    assert _resolve_serve_buckets(args) == "1,4,16,64"
+    args.buckets = "1,8"     # explicit flag always wins
+    assert _resolve_serve_buckets(args) == "1,8"
     args = build_parser().parse_args(
         ["bench_serve", "--requests", "10", "--concurrency", "2"])
     assert args.requests == 10

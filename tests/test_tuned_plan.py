@@ -1,0 +1,412 @@
+"""TunedPlan tests: resolution precedence, plan-key/provenance refusal,
+store corruption tolerance, the trial-hygiene estimator, tune smoke
+persist + memo-hit, and the anchor — BITWISE training parity between a
+run with an auto-loaded plan and the same run with the equivalent
+explicit flags (the resolution layer must be a pure re-router of values,
+never a second code path)."""
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+SMALLNET = """
+name: "PlanNet"
+layers {
+  name: "src" type: MEMORY_DATA top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 1 height: 12 width: 12 }
+}
+layers {
+  name: "conv1" type: CONVOLUTION bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } }
+}
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers { name: "pool1" type: POOLING bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layers {
+  name: "ip1" type: INNER_PRODUCT bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 5
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } }
+}
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "ip1" bottom: "label"
+  top: "loss" }
+"""
+
+
+@pytest.fixture
+def policy_guard():
+    """Snapshot/restore every piece of process-global state the plan
+    resolution layer touches, so these tests cannot leak policy into the
+    rest of the suite."""
+    from poseidon_tpu import config
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    pol = config.policy()
+    saved_policy = {"conv_layout": pol.conv_layout,
+                    "conv_strategy": pol.conv_strategy}
+    saved_pipe = dataclasses.asdict(config.pipeline_config())
+    saved_cc = config.compile_cache_config().cache_dir
+    saved_active = tp.active_resolution()
+    yield
+    config.set_policy(**saved_policy)
+    config.set_pipeline_config(**saved_pipe)
+    config.set_compile_cache_config(cache_dir=saved_cc)
+    tp.set_active_resolution(saved_active)
+
+
+def _plan_doc(model, knobs, **overrides):
+    """A store-shaped plan doc whose provenance matches THIS process."""
+    import jax
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    doc = {
+        "version": tp.PLAN_VERSION,
+        "model": model,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "jax_version": jax.__version__,
+        "n_devices": jax.device_count(),
+        "key": tp.plan_key(model, jax.default_backend(),
+                           jax.device_count()),
+        "knobs": knobs,
+        "trials": {},
+        "measured_at": "2026-01-01T00:00:00Z",
+        "search_cost_s": 1.0,
+    }
+    doc.update(overrides)
+    return doc
+
+
+# --------------------------------------------------------------------------- #
+# resolution precedence + provenance
+# --------------------------------------------------------------------------- #
+
+def test_resolution_precedence_flag_plan_default():
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    doc = {"knobs": {"conv_layout": "NHWC", "arena_bucket_mb": 1.0},
+           "key": "k" * 32}
+    res = tp.resolve(doc, {"conv_layout": "NCHW"})
+    # explicit flag > plan
+    assert res.values["conv_layout"] == "NCHW"
+    assert res.sources["conv_layout"] == "flag"
+    # plan > built-in default
+    assert res.values["arena_bucket_mb"] == 1.0
+    assert res.sources["arena_bucket_mb"] == "plan"
+    # built-in default bats last
+    assert res.values["device_prefetch"] == \
+        tp.BUILTIN_DEFAULTS["device_prefetch"]
+    assert res.sources["device_prefetch"] == "default"
+    # the shadowed measured winner is recorded as an override
+    assert res.overridden == ["conv_layout"]
+    prov = res.provenance()
+    assert prov["conv_layout"] == "NCHW (flag)"
+    assert prov["arena_bucket_mb"] == "1.0 (plan)"
+    assert prov["overridden_by_flags"] == "conv_layout"
+
+
+def test_resolution_without_plan_is_all_defaults(policy_guard):
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    res = tp.resolve(None, {}, store="/somewhere/we/looked")
+    assert set(res.values) == set(tp.TRAIN_KNOBS)
+    assert all(src == "default" for src in res.sources.values())
+    assert res.overridden == []
+    assert "plan_key" not in res.provenance()
+    # a defaults-only resolution must NOT publish a store for conv_tune's
+    # fallback — only an actually-loaded plan routes the per-layer store
+    tp.set_active_resolution(res)
+    assert tp.active_store_dir() == ""
+    doc = {"knobs": {}, "key": "k" * 32}
+    tp.set_active_resolution(tp.resolve(doc, {}, store="/plan/store"))
+    assert tp.active_store_dir() == "/plan/store"
+
+
+# --------------------------------------------------------------------------- #
+# plan-key / provenance mismatch refuses to auto-load, loudly
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("field,value", [("device_kind", "TPU v5e"),
+                                         ("jax_version", "9.9.9")])
+def test_plan_refuses_mismatched_provenance(tmp_path, capsys, field, value):
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    doc = _plan_doc("mismatch", {"conv_layout": "NHWC"}, **{field: value})
+    tp.save_plan(doc, cache_dir=str(tmp_path))
+    assert tp.load_plan("mismatch", cache_dir=str(tmp_path)) is None
+    out = capsys.readouterr().out
+    assert "REFUSING" in out and field in out
+    # ...and the matching provenance loads fine
+    good = _plan_doc("mismatch", {"conv_layout": "NHWC"})
+    tp.save_plan(good, cache_dir=str(tmp_path))
+    loaded = tp.load_plan("mismatch", cache_dir=str(tmp_path))
+    assert loaded is not None and loaded["knobs"]["conv_layout"] == "NHWC"
+
+
+def test_different_backend_or_devices_is_a_clean_miss(tmp_path):
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    doc = _plan_doc("missy", {"conv_layout": "NHWC"})
+    tp.save_plan(doc, cache_dir=str(tmp_path))
+    # a different device COUNT keys to a different plan: miss, defaults
+    assert tp.load_plan("missy", n_devices=2 ** 14,
+                        cache_dir=str(tmp_path)) is None
+    # different model name: miss
+    assert tp.load_plan("other", cache_dir=str(tmp_path)) is None
+
+
+# --------------------------------------------------------------------------- #
+# tuned store satellites: atomic save, torn-entry tolerance (loud)
+# --------------------------------------------------------------------------- #
+
+def test_save_tuned_atomic_no_tmp_litter(tmp_path):
+    from poseidon_tpu.runtime.compile_cache import (load_tuned, save_tuned,
+                                                    tuned_path)
+
+    path = save_tuned(str(tmp_path), "ns", "k1", {"winner": "x"})
+    assert path == tuned_path(str(tmp_path), "ns", "k1")
+    litter = [n for n in os.listdir(os.path.dirname(path)) if ".tmp" in n]
+    assert litter == []
+    assert load_tuned(str(tmp_path), "ns", "k1") == {"winner": "x"}
+
+
+def test_load_tuned_torn_entry_is_loud_miss(tmp_path, capsys):
+    from poseidon_tpu.runtime.compile_cache import (load_tuned, save_tuned,
+                                                    tuned_path)
+
+    save_tuned(str(tmp_path), "ns", "k2", {"winner": "x"})
+    with open(tuned_path(str(tmp_path), "ns", "k2"), "w") as f:
+        f.write('{"winner": "x"')          # torn mid-write
+    assert load_tuned(str(tmp_path), "ns", "k2") is None
+    assert "torn/unreadable" in capsys.readouterr().out
+    # a clean miss (no file at all) stays silent
+    assert load_tuned(str(tmp_path), "ns", "nope") is None
+    assert "torn" not in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------------- #
+# trial hygiene: warm-up + interleaved min-of-k
+# --------------------------------------------------------------------------- #
+
+def test_interleaved_estimator_not_fooled_by_first_call_cost():
+    """A candidate whose first calls pay a large one-time cost (compile
+    noise) but which is genuinely fastest afterwards must WIN: the warm-up
+    calls absorb the one-time cost before any timing starts. This is the
+    conv_tune trial-hygiene contract."""
+    from poseidon_tpu.runtime.tuned_plan import interleaved_min_ms
+
+    calls = {"compiley": 0, "steady": 0}
+
+    def compiley():
+        calls["compiley"] += 1
+        time.sleep(0.05 if calls["compiley"] <= 2 else 0.001)
+
+    def steady():
+        calls["steady"] += 1
+        time.sleep(0.005)
+
+    ms = interleaved_min_ms({"compiley": compiley, "steady": steady},
+                            windows=3, iters=2, warmup=2)
+    assert ms["compiley"] < ms["steady"]
+    # warm-up ran before timing: the 2 expensive calls were absorbed
+    assert calls["compiley"] >= 2 + 3 * 2
+
+
+def test_conv_tune_resolve_uses_interleaved_hygiene(monkeypatch, tmp_path):
+    """conv_tune's measurement must route through the shared estimator
+    (warm-up + interleaved windows), not per-candidate sequential loops."""
+    from poseidon_tpu.ops import conv_tune
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    seen = {}
+    real = tp.interleaved_min_ms
+
+    def spy(fns, **kw):
+        seen["candidates"] = sorted(fns)
+        seen["kw"] = kw
+        return real(fns, **kw)
+
+    monkeypatch.setattr(tp, "interleaved_min_ms", spy)
+    conv_tune.clear_memo()
+    doc = conv_tune.resolve("convH", c=3, h=10, w=10, kernel=(3, 3),
+                            stride=(1, 1), pad=(0, 0), group=1, out_ch=4,
+                            layout="NCHW", batch=4,
+                            cache_dir=str(tmp_path))
+    conv_tune.clear_memo()
+    assert doc["source"] == "measured"
+    assert seen["candidates"] == sorted(doc["timings_ms"])
+    assert seen["kw"]["warmup"] == conv_tune.TRIAL_WARMUP >= 2
+    assert seen["kw"]["windows"] == conv_tune.TRIAL_WINDOWS >= 2
+    assert doc["winner"] == min(doc["timings_ms"],
+                                key=doc["timings_ms"].get)
+
+
+# --------------------------------------------------------------------------- #
+# conv_layout "auto": the measured plan row replaces the builtin table
+# --------------------------------------------------------------------------- #
+
+def test_conv_layout_auto_consults_active_plan(policy_guard):
+    from poseidon_tpu.numeric import resolve_conv_layout
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    assert resolve_conv_layout("auto", backend="cpu") == "NCHW"
+    doc = {"knobs": {"conv_layout": "NHWC"}, "key": "k" * 32}
+    tp.set_active_resolution(tp.resolve(doc, {}))
+    # the measured row IS the auto answer now
+    assert resolve_conv_layout("auto", backend="cpu") == "NHWC"
+    # the tune search builds its default arm against the builtin table
+    assert resolve_conv_layout("auto", backend="cpu",
+                               consult_plan=False) == "NCHW"
+    # explicit layouts never consult the plan
+    assert resolve_conv_layout("NCHW", backend="cpu") == "NCHW"
+    # a flag-sourced resolution is not a measured row
+    tp.set_active_resolution(tp.resolve(doc, {"conv_layout": "NCHW"}))
+    assert tp.active_plan_value("conv_layout") is None
+    tp.set_active_resolution(None)
+    assert resolve_conv_layout("auto", backend="cpu") == "NCHW"
+
+
+# --------------------------------------------------------------------------- #
+# tune smoke: persists a plan, second run memo-hits and skips measurement
+# --------------------------------------------------------------------------- #
+
+def test_tune_smoke_persists_then_memo_hits(tmp_path, policy_guard):
+    from poseidon_tpu.proto.messages import load_net_from_string
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    net_param = load_net_from_string(SMALLNET)
+    shapes = {"data": (8, 1, 12, 12), "label": (8,)}
+    r = tp.run_tune("plannet", smoke=True, cache_dir=str(tmp_path),
+                    net_param=net_param, source_shapes=shapes,
+                    knobs=["conv_layout"], windows=1, iters=1)
+    assert r["source"] == "measured"
+    doc = r["doc"]
+    # the artifact is complete: every knob resolved, provenance stamped
+    assert set(doc["knobs"]) == set(tp.BUILTIN_DEFAULTS)
+    assert doc["trials"]["conv_layout"]["source"] == "measured"
+    assert set(doc["trials"]["conv_layout"]["timings_ms"]) == \
+        {"NCHW", "NHWC"}
+    assert doc["ab"]["speedup"] >= 1.0        # default is always a candidate
+    # restricted knobs are RECORDED, never silently capped
+    assert "pipeline" in doc["skipped"]
+    assert doc["device_kind"] and doc["jax_version"]
+    assert os.path.exists(r["path"])
+    with open(r["path"]) as f:
+        assert json.load(f)["key"] == doc["key"]
+    # second run: memo-hit, no re-measurement
+    t0 = time.perf_counter()
+    r2 = tp.run_tune("plannet", smoke=True, cache_dir=str(tmp_path))
+    assert r2["source"] == "persisted"
+    assert r2["doc"]["key"] == doc["key"]
+    assert time.perf_counter() - t0 < 5.0     # loaded, not measured
+    # --force re-measures
+    r3 = tp.run_tune("plannet", smoke=True, cache_dir=str(tmp_path),
+                     net_param=net_param, source_shapes=shapes,
+                     knobs=["conv_layout"], windows=1, iters=1, force=True)
+    assert r3["source"] == "measured"
+
+
+# --------------------------------------------------------------------------- #
+# the anchor: auto-loaded plan == equivalent explicit flags, BITWISE
+# --------------------------------------------------------------------------- #
+
+def _memory_data(n=192, seed=0):
+    rs = np.random.RandomState(seed)
+    templates = rs.randn(5, 1, 12, 12).astype(np.float32)
+    labels = rs.randint(0, 5, size=n)
+    data = templates[labels] + \
+        0.25 * rs.randn(n, 1, 12, 12).astype(np.float32)
+    return {"data": data, "label": labels}
+
+
+def _train_leaves(tmp_path, sub, engine_kw):
+    import jax
+    from poseidon_tpu.parallel import CommConfig
+    from poseidon_tpu.proto.messages import (SolverParameter,
+                                             load_net_from_string)
+    from poseidon_tpu.runtime.engine import Engine
+
+    out = tmp_path / sub
+    out.mkdir()
+    sp = SolverParameter(train_net_param=load_net_from_string(SMALLNET),
+                         base_lr=0.05, lr_policy="fixed", momentum=0.9,
+                         weight_decay=5e-4, display=0, max_iter=8,
+                         random_seed=3)
+    comm = CommConfig(param_arena=True,
+                      arena_bucket_mb=engine_kw.pop("arena_bucket_mb"))
+    eng = Engine(sp, comm=comm, memory_data=_memory_data(),
+                 output_dir=str(out), **engine_kw)
+    try:
+        eng.train()
+        return [np.asarray(v).copy()
+                for v in jax.tree_util.tree_leaves(eng.params)]
+    finally:
+        eng.close()
+
+
+def test_autoloaded_plan_bitwise_equals_explicit_flags(tmp_path,
+                                                       policy_guard):
+    """The acceptance anchor: a training run whose knobs came from an
+    auto-loaded TunedPlan must be BITWISE identical to the same run with
+    the equivalent explicit flags — plan resolution re-routes values, it
+    is never a second code path."""
+    from poseidon_tpu import config
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    knobs = {"conv_layout": "NHWC", "conv_strategy": "",
+             "arena_bucket_mb": 1.0, "mesh": "",
+             "device_prefetch": 0, "max_in_flight": 1,
+             "steps_per_dispatch": 1,
+             "serve_buckets": tp.BUILTIN_DEFAULTS["serve_buckets"]}
+    store = tmp_path / "store"
+    tp.save_plan(_plan_doc("plannet", knobs), cache_dir=str(store))
+
+    # arm A: the cmd_train path — load, resolve (no flags), apply
+    doc = tp.load_plan("plannet", cache_dir=str(store))
+    assert doc is not None
+    res = tp.resolve(doc, {}, store=str(store))
+    assert all(res.sources[k] == "plan" for k in tp.TRAIN_KNOBS)
+    eng_kw = tp.apply_training_resolution(res)
+    assert tp.active_resolution() is res
+    leaves_plan = _train_leaves(tmp_path, "via_plan", {
+        "arena_bucket_mb": eng_kw["arena_bucket_mb"],
+        "device_prefetch": eng_kw["device_prefetch"],
+        "max_in_flight": eng_kw["max_in_flight"],
+        "steps_per_dispatch": eng_kw["steps_per_dispatch"]})
+
+    # arm B: the same knobs as explicit settings, no plan anywhere
+    tp.set_active_resolution(None)
+    config.set_policy(conv_layout="NHWC")
+    leaves_flags = _train_leaves(tmp_path, "via_flags", {
+        "arena_bucket_mb": 1.0, "device_prefetch": 0, "max_in_flight": 1,
+        "steps_per_dispatch": 1})
+
+    assert len(leaves_plan) == len(leaves_flags)
+    for a, b in zip(leaves_plan, leaves_flags):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_writes_plan_provenance_section(tmp_path, policy_guard):
+    """A run with an active resolution carries the tuned_plan section —
+    values, sources, and overrides — into stats.yaml."""
+    from poseidon_tpu.runtime import tuned_plan as tp
+
+    knobs = {"conv_layout": "NCHW", "conv_strategy": "",
+             "arena_bucket_mb": 4.0, "mesh": "", "device_prefetch": 0,
+             "max_in_flight": 1, "steps_per_dispatch": 1,
+             "serve_buckets": tp.BUILTIN_DEFAULTS["serve_buckets"]}
+    doc = _plan_doc("plannet", knobs)
+    res = tp.resolve(doc, {"max_in_flight": 1}, store=str(tmp_path))
+    tp.apply_training_resolution(res)
+    _train_leaves(tmp_path, "prov", {
+        "arena_bucket_mb": 4.0, "device_prefetch": 0, "max_in_flight": 1,
+        "steps_per_dispatch": 1})
+    stats = (tmp_path / "prov" / "stats.yaml").read_text()
+    assert "tuned_plan:" in stats
+    assert "conv_layout: NCHW (plan)" in stats
+    assert "max_in_flight: 1 (flag)" in stats
+    assert f"plan_key: {doc['key']}" in stats
