@@ -20,7 +20,16 @@ prove placement/comm properties rather than sample them:
 - ``contracts.py`` — per-model golden HLO contracts
   (``evidence/hlo_contracts/*.json``): gradient all-reduce count, layout
   transposes, donation census, dtype census, fusion count — verified by
-  compiling each model on CPU and diffing.
+  compiling each model on CPU and diffing — plus the cross-participant
+  collective-schedule consistency gate (``collective_consistency``).
+- ``protocol.py`` — wire-schema lint (PROTO201-PROTO207): the dict-
+  ``kind`` RPC vocabulary of the async-SSP and serving socket tiers,
+  AST-extracted from dispatchers AND senders, cross-checked, and emitted
+  as the checked-in schema golden ``evidence/protocol_schema.json``.
+- ``model_check.py`` — exhaustive bounded model checking of the
+  SSP/managed-communication protocol (durable-clock gates, partial
+  pushes, admit/retire, exactly-once replay), with seeded-mutation
+  self-tests and real-run trace conformance.
 
 Findings carry ``file:line`` + rule id and a line-number-free fingerprint;
 ``baseline.json`` grandfathers pre-existing findings so CI fails only on
@@ -248,6 +257,15 @@ def run_lints(paths: Optional[Sequence[str]] = None,
             per_file.extend(jit_hygiene.lint_file(path, source, tree=tree))
         findings.extend(f for f in per_file
                         if not pragma_suppressed(lines, f, tree=tree))
+    if paths is None:
+        # the wire-schema lint is CROSS-file (dispatchers in one module,
+        # senders in another), so it runs against its own configured
+        # service specs rather than per file — but only on the default
+        # sweep: restricting the lint to explicit paths must not drag in
+        # findings about files the caller did not ask about. Its
+        # findings share the fingerprint/baseline/pragma machinery.
+        from . import protocol
+        findings.extend(protocol.run_protocol_lint())
     if rules:
         # infrastructure findings (vanished target, unparseable file)
         # survive any --rules restriction — a rule-filtered hook must

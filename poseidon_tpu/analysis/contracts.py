@@ -69,11 +69,59 @@ _BATCH = 8          # one row per device on the 8-device virtual mesh
 # compiler-whim-level); everything else is exact only under the recorded
 # jax version. The collective_schedule keys are structural — the planner
 # states them and lowering preserves them (chained buckets cannot merge).
+# The full collective "sequence" (op order + replica groups + normalized
+# channel ids) is deliberately NOT robust: op ordering inside the lowered
+# module is a compiler artifact across versions; under ONE version it is
+# deterministic, which is exactly what the cross-participant consistency
+# gate (collective_consistency) relies on.
 ROBUST_KEYS = ("gradient_all_reduces", "layout_transposes", "f64_tensors",
                "mesh", "arena_buckets", "tp_modes", "planned_counts",
                "lowered_counts", "planned_matches_lowered")
 
+# the ops whose cross-participant divergence is a silent SPMD hang: a
+# mesh member waiting in a collective its peers never entered (or
+# entered with different groups/channels)
+_COLLECTIVE_OP_RE = re.compile(
+    r'"stablehlo\.(all_reduce|all_gather|reduce_scatter|all_to_all|'
+    r'collective_permute|collective_broadcast)"')
+_GROUPS_RE = re.compile(r"replica_groups\s*=\s*dense<([^>]*)>")
+_CHANNEL_RE = re.compile(r"channel_handle<handle\s*=\s*(\d+)")
+_DIM_RE = re.compile(r"(all_gather_dim|scatter_dimension|"
+                     r"split_dimension|concat_dimension)\s*=\s*(\d+)")
+
 _TENSOR_DTYPE_RE = re.compile(r"tensor<[0-9x]*([a-z][a-z0-9]*)>")
+
+
+def collective_sequence(stablehlo: str) -> List[str]:
+    """The ordered collective schedule of a lowered module: one
+    normalized entry per collective op, in program order —
+    ``op|replica_groups|dims|cN``. Channel ids are renumbered by first
+    appearance (c0, c1, ...) so two participants' programs compare equal
+    iff their schedules really match, even though jax's channel counter
+    is process-global. This is the static form of the cross-participant
+    contract: every mesh member must lower the IDENTICAL sequence, or
+    some member ends up waiting in a collective its peers never enter —
+    the silent-hang failure mode of multi-slice composition."""
+    entries: List[str] = []
+    chan_map: Dict[str, str] = {}
+    for m in _COLLECTIVE_OP_RE.finditer(stablehlo):
+        # attributes live between the op token and the body brace of the
+        # same instruction; the next op's match bounds the slice
+        end = stablehlo.find("({", m.end())
+        nxt = _COLLECTIVE_OP_RE.search(stablehlo, m.end())
+        stop = min(x for x in (end if end != -1 else len(stablehlo),
+                               nxt.start() if nxt else len(stablehlo)))
+        attrs = stablehlo[m.end():stop]
+        g = _GROUPS_RE.search(attrs)
+        groups = "".join((g.group(1) if g else "?").split())
+        ch = _CHANNEL_RE.search(attrs)
+        if ch:
+            cid = chan_map.setdefault(ch.group(1), f"c{len(chan_map)}")
+        else:
+            cid = "c?"
+        dims = ",".join(f"{k}={v}" for k, v in _DIM_RE.findall(attrs))
+        entries.append(f"{m.group(1)}|{groups}|{dims}|{cid}")
+    return entries
 
 
 class ContractEnvironmentError(RuntimeError):
@@ -206,40 +254,26 @@ def build_contract(model: str) -> Dict:
         # dp2 x fsdp2 x tp2 uses all 8 virtual devices; counted on the
         # LOWERED program (combiner-proof: the chained buckets cannot
         # merge, and XLA never splits a collective).
-        from ..config import MeshConfig
-        from ..core.net import Net
-        from ..parallel.spmd import (ShardingPlan, build_spmd_train_step,
-                                     named_mesh)
         from ..runtime.hlo_comm import collective_census_stablehlo
-        mcfg = MeshConfig(data=2, fsdp=2, tp=2)
-        smesh = named_mesh(mcfg)
-        n_dp = mcfg.data * mcfg.fsdp
-        if model == "lenet":
-            from ..models import zoo as _zoo
-            mshapes = _zoo.lenet_shapes(_BATCH // n_dp)
-        else:
-            mshapes = {"data": (_BATCH // n_dp, spec["channels"],
-                                spec["image"], spec["image"]),
-                       "label": (_BATCH // n_dp,)}
-        mnet = Net(net.net_param, "TRAIN", source_shapes=mshapes)
-        plan = ShardingPlan.build(mnet, mcfg, cc)
-        mts = build_spmd_train_step(mnet, sp, smesh, plan, cc,
-                                    donate=False)
-        mparams = mnet.init(jax.random.PRNGKey(0))
-        mstate = init_train_state(mparams, cc, n_dp)
-        mlowered = mts.lowerable.lower(mparams, mstate, batch,
-                                      jax.random.PRNGKey(7))
-        census = collective_census_stablehlo(mlowered.as_text())
-        sched = plan.collective_schedule(mts.arena, mnet, comm=cc)
+        mtxt, plan, marena, mcfg, mnet, mcc = _lower_mesh_participant(model)
+        census = collective_census_stablehlo(mtxt)
+        # the planned schedule must be stated with the SAME CommConfig
+        # the plan was built from, or planned-vs-lowered diffs for a
+        # config reason rather than a lowering one
+        sched = plan.collective_schedule(marena, mnet, comm=mcc)
         contract["collective_schedule"] = {
             "mesh": mcfg.describe(),
-            "arena_buckets": (mts.arena.n_buckets
-                              if mts.arena is not None else 0),
+            "arena_buckets": (marena.n_buckets
+                              if marena is not None else 0),
             "tp_modes": {l: d.mode
                          for l, d in sorted(plan.tp_layers.items())},
             "planned_counts": sched["counts"],
             "lowered_counts": census,
             "planned_matches_lowered": census == sched["counts"],
+            # the full ordered schedule (op|groups|dims|channel): diffed
+            # exactly under the recorded jax version, and the substrate
+            # of the cross-participant consistency gate below
+            "sequence": collective_sequence(mtxt),
         }
     if spec["optimized"]:
         compiled = lowered.compile()
@@ -251,6 +285,103 @@ def build_contract(model: str) -> Dict:
             "fusion_count": _fusion_count(ctxt),
         }
     return contract
+
+
+def _lower_mesh_participant(model: str):
+    """Build + lower the dp2 x fsdp2 x tp2 sharded step EXACTLY as one
+    mesh participant would — fresh Net, fresh plan, fresh trace — and
+    return (stablehlo_text, plan, arena, mesh_config, net, comm_config).
+    Called once by :func:`build_contract` and N times by
+    :func:`collective_consistency` (each call IS one participant)."""
+    ensure_virtual_mesh()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..config import MeshConfig
+    from ..core.net import Net
+    from ..parallel import CommConfig, init_train_state
+    from ..parallel.spmd import (ShardingPlan, build_spmd_train_step,
+                                 named_mesh)
+    from ..proto.messages import SolverParameter
+
+    net, spec = _build_net(model)
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0005)
+    cc = CommConfig()
+    mcfg = MeshConfig(data=2, fsdp=2, tp=2)
+    smesh = named_mesh(mcfg)
+    n_dp = mcfg.data * mcfg.fsdp
+    if model == "lenet":
+        from ..models import zoo as _zoo
+        mshapes = _zoo.lenet_shapes(_BATCH // n_dp)
+    else:
+        mshapes = {"data": (_BATCH // n_dp, spec["channels"],
+                            spec["image"], spec["image"]),
+                   "label": (_BATCH // n_dp,)}
+    mnet = Net(net.net_param, "TRAIN", source_shapes=mshapes)
+    plan = ShardingPlan.build(mnet, mcfg, cc)
+    mts = build_spmd_train_step(mnet, sp, smesh, plan, cc, donate=False)
+    mparams = mnet.init(jax.random.PRNGKey(0))
+    mstate = init_train_state(mparams, cc, n_dp)
+    rs = np.random.RandomState(0)
+    shape = (_BATCH, spec["channels"], spec["image"], spec["image"])
+    batch = {"data": jnp.asarray(rs.randn(*shape).astype(np.float32)),
+             "label": jnp.asarray(rs.randint(0, spec["classes"],
+                                             size=(_BATCH,)))}
+    mlowered = mts.lowerable.lower(mparams, mstate, batch,
+                                   jax.random.PRNGKey(7))
+    return mlowered.as_text(), plan, mts.arena, mcfg, mnet, cc
+
+
+def collective_consistency(models: Sequence[str] = ("lenet",),
+                           participants: int = 2) -> Tuple[bool, Dict]:
+    """The cross-participant collective gate: lower the sharded step
+    ``participants`` times INDEPENDENTLY (fresh net, fresh planner state,
+    fresh trace — what each process of a multi-process mesh, or each
+    slice of ROADMAP item 4's cross-slice tier, would do on its own) and
+    require the extracted collective sequences to be IDENTICAL: same ops
+    in the same order, same replica groups, same dims, same normalized
+    channel assignment. Any divergence is the mismatched-collective
+    silent hang, caught at diff time instead of as a wedged pod."""
+    report: Dict = {}
+    ok = True
+    for model in models:
+        if not _SPECS.get(model, {}).get("mesh"):
+            report[model] = {"ok": True, "skipped":
+                             "no mesh spec for this model", "diffs": []}
+            continue
+        seqs = [collective_sequence(_lower_mesh_participant(model)[0])
+                for _ in range(max(2, participants))]
+        # a degenerate extraction must REFUSE, never vacuously pass: if
+        # an MLIR printing change moves replica_groups out of the attr
+        # slice, every entry degrades to 'op|?|...' and two genuinely
+        # divergent participants would compare equal — the exact hang
+        # this gate exists to catch. RuntimeError -> CLI exit 4 (infra).
+        for p, seq in enumerate(seqs):
+            bad = [e for e in seq if "|?|" in e]
+            if not seq or bad:
+                raise RuntimeError(
+                    f"{model} participant {p}: collective sequence "
+                    f"extraction degenerated ({'empty' if not seq else bad[0]!r}"
+                    f") — the stablehlo printing no longer matches "
+                    f"collective_sequence's attribute scan; fix the "
+                    f"extractor before trusting this gate")
+        diffs: List[str] = []
+        base = seqs[0]
+        for p, seq in enumerate(seqs[1:], start=1):
+            if len(seq) != len(base):
+                diffs.append(f"participant {p}: {len(seq)} collectives "
+                             f"vs participant 0's {len(base)}")
+            for i, (a, b) in enumerate(zip(base, seq)):
+                if a != b:
+                    diffs.append(f"participant {p} diverges at "
+                                 f"collective #{i}: {a!r} vs {b!r}")
+                    break       # first divergence per participant
+        report[model] = {"ok": not diffs, "participants": len(seqs),
+                         "sequence_len": len(base), "diffs": diffs}
+        ok = ok and not diffs
+    return ok, report
 
 
 def load_contract(model: str) -> Optional[Dict]:

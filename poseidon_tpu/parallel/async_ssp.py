@@ -384,7 +384,8 @@ class ParamService:
                  host: str = "127.0.0.1", port: int = 0,
                  server_logic: str = "inc", init_step: float = 0.1,
                  liveness_timeout_s: Optional[float] = None,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 record_events: bool = False):
         if server_logic not in ("inc", "adarevision"):
             raise ValueError(f"unknown server_logic {server_logic!r}")
         # default bind is LOOPBACK-ONLY (host="127.0.0.1"); a wider bind is
@@ -449,6 +450,13 @@ class ParamService:
         self.evictions = 0   # liveness-timeout evictions (telemetry)
         self.rejoins = 0     # un-evictions via later activity (telemetry)
         self.bad_frames = 0  # malformed/truncated frames dropped (telemetry)
+        # protocol event log for the model-checker's trace-conformance
+        # harness (analysis/model_check.conform_service_events): the
+        # state-machine-relevant events, in service apply order, appended
+        # under self._lock. Off by default — a telemetry list growing one
+        # tuple per push is cheap, but recording is a test/debug decision
+        self._record_events = record_events
+        self.events: List[Tuple] = []
         self._srv = socket.create_server((host, port))
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
@@ -562,6 +570,8 @@ class ParamService:
         self.admissions += 1
         self.n_workers = max(self.n_workers, len(self.members))
         self._version += 1
+        if self._record_events:
+            self.events.append(("admit", w, join))
         _log(f"ParamService: admitted worker {w} at join clock {join} "
              f"({len(self.members)} members)")
         return join
@@ -616,6 +626,10 @@ class ParamService:
                         seq = msg.get("seq", msg["clock"])
                         with self._lock:
                             dup = seq <= self.applied_seq.get(w, -1)
+                            if self._record_events:
+                                self.events.append(
+                                    ("push", w, msg["clock"],
+                                     bool(msg.get("full", True)), dup))
                             if not dup:
                                 if self.server_logic == "adarevision":
                                     # partial (sparse) pushes are refused
@@ -693,6 +707,8 @@ class ParamService:
                                 self.members.discard(w)
                                 self.retired.add(w)
                                 self.failed_workers.discard(w)
+                                if self._record_events:
+                                    self.events.append(("retire", w))
                                 _log(f"ParamService: worker {w} retired "
                                      f"(clock {self.clocks.get(w, -1)}); "
                                      f"{len(self.members)} members remain")
@@ -708,6 +724,8 @@ class ParamService:
                         # done_count to decide when the anchor is final)
                         with self._lock:
                             self.done_workers.add(msg["worker"])
+                            if self._record_events:
+                                self.events.append(("done", msg["worker"]))
                         _send_msg(conn, {"ok": True})
                     elif kind == "bye":
                         _send_msg(conn, {"ok": True})
@@ -802,7 +820,8 @@ class AsyncSSPClient:
                  budget_mbps: Optional[float] = None,
                  priority_frac: float = 0.1,
                  adaptive: bool = False,
-                 bucket_clock: Callable[[], float] = time.monotonic):
+                 bucket_clock: Callable[[], float] = time.monotonic,
+                 record_events: bool = False):
         self.worker = worker
         self.auth_token = _env_auth_token(auth_token)
         self.n_workers = n_workers if n_workers else worker + 1
@@ -857,6 +876,13 @@ class AsyncSSPClient:
         # telemetry reads it concurrently)
         self._stats_lock = threading.Lock()
         self.reconnects = 0
+        # gate-admission event log for the model checker's conformance
+        # harness (("gate", worker, clock, min_peer_durable) per PASSED
+        # gate — what the real gate actually observed when it admitted
+        # the read). Train-thread writes, but appended under _stats_lock
+        # so a test can read it concurrently without a torn list.
+        self._record_events = record_events
+        self.events: List[Tuple] = []
         # initial connect: the service may come up AFTER the workers under
         # a real launcher — retry_s is the rendezvous deadline
         self._push_sock = self._dial(retry_s)
@@ -1295,13 +1321,15 @@ class AsyncSSPClient:
         backstop ``timeout_s``."""
         self._check_alive()
         need = clock - self.staleness - 1
-        if self._min_other_clock() >= need:
+        seen = self._min_other_clock()
+        if seen >= need:
+            self._record_gate(clock, seen)
             return 0.0
         t0 = time.time()
         self.gate_blocks += 1
         with _spans.span("async_gate", "async",
                          {"worker": self.worker, "clock": clock}):
-            while self._min_other_clock() < need:
+            while (seen := self._min_other_clock()) < need:
                 self._check_alive()
                 if time.time() - t0 > timeout_s:
                     with self._stats_lock:
@@ -1316,9 +1344,20 @@ class AsyncSSPClient:
                 resp = self._pull_rpc({"kind": "clocks"})
                 self._absorb_view(resp)
                 time.sleep(poll_s)
+        self._record_gate(clock, seen)
         waited = time.time() - t0
         self.blocked_s += waited
         return waited
+
+    def _record_gate(self, clock: int, seen: int) -> None:
+        """Log one PASSED gate for the trace-conformance harness: the
+        min peer durable clock the gate actually admitted against.
+        ``seen`` is computed by the caller BEFORE taking _stats_lock
+        (_min_other_clock acquires it itself — re-entering would
+        self-deadlock, THR002's exact shape)."""
+        if self._record_events:
+            with self._stats_lock:
+                self.events.append(("gate", self.worker, clock, seen))
 
     # ---- cache refresh (read-my-writes) --------------------------------- #
     def refresh(self) -> Tuple[Dict, Dict[int, int]]:
@@ -1602,6 +1641,10 @@ def run_async_ssp_worker(
                 "blocked_s": cli.blocked_s, "gate_blocks": cli.gate_blocks,
                 "wall_s": wall, "final_clock": cli.clock,
                 "reconnects": cli.reconnects, "start_clock": start_clock,
-                "retired": retired}
+                "retired": retired,
+                # recorded gate admissions (empty unless client_opts set
+                # record_events) for the model checker's conformance
+                # harness — the client object dies with close() below
+                "events": list(cli.events)}
     finally:
         cli.close()

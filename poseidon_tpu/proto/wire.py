@@ -48,19 +48,78 @@ class FrameError(ConnectionError):
     service can log it distinctly instead of dying in the handler."""
 
 
+class FrameTooLargeError(ValueError):
+    """SEND-side refusal of an over-cap frame. Deliberately NOT a
+    ConnectionError/FrameError: the failure is deterministic and local
+    (re-dialing and re-sending the same oversized pickle can never
+    succeed), so it must surface loudly to the caller immediately —
+    reconnect-and-replay machinery retrying it for the whole backoff
+    deadline would bury the one error message that names the fix
+    (POSEIDON_MAX_FRAME_BYTES on both ends)."""
+
+
 # A garbage 8-byte header read as a length is astronomically large (ASCII
 # bytes decode to ~10^16); cap frames so it fails fast as a FrameError
-# instead of an attempted multi-petabyte recv.
-MAX_FRAME = 1 << 32
+# BEFORE any allocation instead of an attempted multi-petabyte recv. The
+# cap is configurable (PROTO207 found the original hard-coded 1<<32: a
+# hostile or corrupt header still bought a multi-gigabyte allocation
+# attempt): the default 1 GiB comfortably covers the largest real frame
+# (a dense AlexNet anchor pull is ~240 MB) while an LM-sized deployment
+# can raise it explicitly — a deliberate capacity decision, never a
+# garbage header's.
+DEFAULT_MAX_FRAME = 1 << 30          # 1 GiB
+MAX_FRAME_ENV = "POSEIDON_MAX_FRAME_BYTES"
+_max_frame_override: Optional[int] = None
+
+
+def max_frame_bytes() -> int:
+    """The active frame cap: explicit :func:`set_max_frame_bytes` wins,
+    then the ``POSEIDON_MAX_FRAME_BYTES`` env (the launcher's channel,
+    same distribution as the auth token), then the 1 GiB default."""
+    if _max_frame_override is not None:
+        return _max_frame_override
+    import os
+    env = os.environ.get(MAX_FRAME_ENV)
+    if env:
+        try:
+            n = int(env)
+        except ValueError:
+            n = -1
+        if n > 0:
+            return n
+        # an operator who SET the knob must not be silently told to set
+        # it: warn once (warnings dedups) and fall back to the default
+        import warnings
+        warnings.warn(
+            f"{MAX_FRAME_ENV}={env!r} is not a positive integer byte "
+            f"count; using the default {DEFAULT_MAX_FRAME}",
+            RuntimeWarning, stacklevel=2)
+    return DEFAULT_MAX_FRAME
+
+
+def set_max_frame_bytes(n: Optional[int]) -> None:
+    """Process-wide override (None restores env/default resolution)."""
+    global _max_frame_override
+    if n is not None and n <= 0:
+        raise ValueError(f"frame cap must be positive, got {n}")
+    _max_frame_override = n
 
 
 def send_frame(sock: socket.socket, obj) -> int:
     """Send one frame; returns the ACTUAL wire bytes (header + payload) so
     bandwidth-budgeted callers (the managed-communication token bucket) can
-    account what the link really carried, not an estimate."""
+    account what the link really carried, not an estimate. Refuses frames
+    over the configured cap LOUDLY — the peer would drop the connection
+    at its own cap check, and a send-side error names the knob."""
     buf = io.BytesIO()
     pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
     data = buf.getvalue()
+    cap = max_frame_bytes()
+    if len(data) > cap:
+        raise FrameTooLargeError(
+            f"refusing to send a {len(data)}-byte frame over the "
+            f"{cap}-byte cap (raise {MAX_FRAME_ENV} or "
+            f"set_max_frame_bytes on BOTH ends for frames this large)")
     sock.sendall(struct.pack("!Q", len(data)) + data)
     return len(data) + 8
 
@@ -84,8 +143,14 @@ def recv_frame_sized(sock: socket.socket):
     actual header + payload byte count, the pull-path input to the managed-
     communication bandwidth accounting."""
     (n,) = struct.unpack("!Q", recv_exact(sock, 8))
-    if n > MAX_FRAME:
-        raise FrameError(f"frame length {n} exceeds cap {MAX_FRAME}")
+    cap = max_frame_bytes()
+    if n > cap:
+        # reject BEFORE any payload allocation: a garbage or hostile
+        # header must cost a log line, not a multi-gigabyte recv buffer
+        raise FrameError(
+            f"frame length {n} exceeds cap {cap} (garbage header, or a "
+            f"legitimately huge frame — raise {MAX_FRAME_ENV} on both "
+            f"ends if it is the latter)")
     try:
         payload = recv_exact(sock, n)
     except FrameError:

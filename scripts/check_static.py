@@ -22,6 +22,7 @@ sys.path.insert(0, REPO)
 def main() -> int:
     argv = sys.argv[1:]
     if any(a.startswith("--contracts") or a.startswith("--refresh-contracts")
+           or a.startswith("--collectives")
            for a in argv):
         from poseidon_tpu.analysis.contracts import ensure_virtual_mesh
         ensure_virtual_mesh()

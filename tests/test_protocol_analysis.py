@@ -1,0 +1,635 @@
+"""Protocol verifier tests (ISSUE 15): wire-schema lint (PROTO2xx),
+bounded model checking of the SSP/managed-comm protocol, trace
+conformance against the real tier, and the CLI exit-code contract.
+
+Structure mirrors tests/test_analysis.py: every PROTO rule fires on a
+fixture snippet and stays quiet on its well-formed twin; the model
+checker's explored-state counts are pinned exactly (a model edit that
+silently prunes interleavings must show up as a count change); every
+seeded mutation MUST be caught (a mutation the checker agrees with is a
+checker regression); and a real 2-worker managed-communication run with
+elastic admit + retire replays cleanly through the model's service
+rules.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.analysis import filter_new, load_baseline
+from poseidon_tpu.analysis import model_check as M
+from poseidon_tpu.analysis import protocol as P
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------- #
+# fixtures: a minimal service + client pair the extractor understands
+# --------------------------------------------------------------------------- #
+
+DISPATCHER_OK = '''
+def recv_frame(conn): ...
+def send_frame(conn, obj): ...
+def server_handshake(conn, token): ...
+
+class Service:
+    def _serve(self, conn):
+        if self.token is not None:
+            if not server_handshake(conn, self.token):
+                return
+        while True:
+            msg = recv_frame(conn)
+            kind = msg["kind"]
+            if kind == "ping":
+                send_frame(conn, {"ok": True})
+            elif kind == "put":
+                w = msg["worker"]
+                seq = msg.get("seq", msg["clock"])
+                self.table[w] += msg["delta"]
+                send_frame(conn, {"ok": True, "applied": seq})
+            elif kind == "get":
+                send_frame(conn, {"ok": True, "value": self.table})
+'''
+
+CLIENT_OK = '''
+def send_frame(sock, obj): ...
+def recv_frame(sock): ...
+
+class Client:
+    def _rpc(self, msg):
+        send_frame(self._sock, msg)
+        return recv_frame(self._sock)
+
+    def ping(self):
+        return self._rpc({"kind": "ping"})
+
+    def put(self, delta):
+        self._rpc({"kind": "put", "worker": self.w, "clock": self.c,
+                   "seq": self.c, "delta": delta})
+
+    def get(self):
+        reply = self._rpc({"kind": "get"})
+        return reply["value"]
+'''
+
+
+def _spec(tmp_path, dispatcher_src, client_src, **kw):
+    d = tmp_path / "svc.py"
+    c = tmp_path / "cli.py"
+    d.write_text(textwrap.dedent(dispatcher_src))
+    c.write_text(textwrap.dedent(client_src))
+    return P.ServiceSpec(name="fixture",
+                         dispatcher=(str(d), "Service", "_serve"),
+                         recv_method="_serve",
+                         sender_files=(str(c),), **kw)
+
+
+def _findings(tmp_path, dispatcher_src, client_src, **kw):
+    _, fs = P.extract_service(_spec(tmp_path, dispatcher_src, client_src,
+                                    **kw))
+    return fs
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_well_formed_pair_is_quiet(tmp_path):
+    assert _findings(tmp_path, DISPATCHER_OK, CLIENT_OK) == []
+
+
+def test_schema_extraction_shape(tmp_path):
+    schema, _ = P.extract_service(_spec(tmp_path, DISPATCHER_OK, CLIENT_OK))
+    assert set(schema["kinds"]) == {"ping", "put", "get"}
+    put = schema["kinds"]["put"]
+    assert put["required_fields"] == ["clock", "delta", "worker"]
+    assert put["optional_fields"] == ["seq"]
+    assert put["mutating"] is True            # self.table[w] += delta
+    assert schema["kinds"]["ping"]["mutating"] is False
+    assert schema["kinds"]["get"]["reply_keys"] == ["ok", "value"]
+    assert "value" in schema["kinds"]["get"]["client_reads"]
+
+
+def test_proto201_sent_but_unhandled(tmp_path):
+    bad = CLIENT_OK + '''
+    def stats(self):
+        return self._rpc({"kind": "stats"})
+'''
+    fs = _findings(tmp_path, DISPATCHER_OK, bad)
+    assert _rules(fs) == ["PROTO201"]
+    assert fs[0].key == "kind:stats"
+
+
+def test_proto202_handled_but_never_sent(tmp_path):
+    bad = DISPATCHER_OK + '''\
+            elif kind == "flush":
+                send_frame(conn, {"ok": True})
+'''
+    fs = _findings(tmp_path, bad, CLIENT_OK)
+    assert _rules(fs) == ["PROTO202"]
+    # ...and declaring it external ops vocabulary silences it
+    assert _findings(tmp_path, bad, CLIENT_OK,
+                     external_kinds=("flush",)) == []
+
+
+def test_proto203_required_field_missing_from_sender(tmp_path):
+    bad_d = DISPATCHER_OK.replace(
+        'self.table[w] += msg["delta"]',
+        'self.table[w] += msg["delta"] * msg["scale"]')
+    fs = _findings(tmp_path, bad_d, CLIENT_OK)
+    assert "PROTO203" in _rules(fs)
+    assert any(f.key == "put.scale" for f in fs)
+
+
+def test_proto204_reply_key_never_produced(tmp_path):
+    bad_c = CLIENT_OK.replace('return reply["value"]',
+                              'return reply["valeu"]')
+    fs = _findings(tmp_path, DISPATCHER_OK, bad_c)
+    assert _rules(fs) == ["PROTO204"]
+    assert fs[0].key == "get.reply.valeu"
+    # a .get() read of the same missing key is the caller's explicit
+    # default — no finding
+    ok_c = CLIENT_OK.replace('return reply["value"]',
+                             'return reply.get("valeu")')
+    assert _findings(tmp_path, DISPATCHER_OK, ok_c) == []
+
+
+def test_proto205_unpickle_before_auth_and_no_auth(tmp_path):
+    # handshake AFTER the first frame parse
+    reordered = '''
+    def recv_frame(conn): ...
+    def send_frame(conn, obj): ...
+    def server_handshake(conn, token): ...
+
+    class Service:
+        def _serve(self, conn):
+            msg = recv_frame(conn)
+            if self.token is not None:
+                if not server_handshake(conn, self.token):
+                    return
+            kind = msg["kind"]
+            if kind == "ping":
+                send_frame(conn, {"ok": True})
+    '''
+    fs = _findings(tmp_path, reordered, CLIENT_OK)
+    assert any(f.rule == "PROTO205" and f.key == "unpickle-before-auth"
+               for f in fs)
+    # no handshake anywhere in the class
+    no_auth = '''
+    def recv_frame(conn): ...
+    def send_frame(conn, obj): ...
+
+    class Service:
+        def _serve(self, conn):
+            msg = recv_frame(conn)
+            kind = msg["kind"]
+            if kind == "ping":
+                send_frame(conn, {"ok": True})
+    '''
+    fs = _findings(tmp_path, no_auth, CLIENT_OK)
+    assert any(f.rule == "PROTO205" and f.key == "no-auth" for f in fs)
+
+
+def test_proto206_mutating_kind_missing_seq_clock(tmp_path):
+    bad_c = CLIENT_OK.replace(
+        '''self._rpc({"kind": "put", "worker": self.w, "clock": self.c,
+                   "seq": self.c, "delta": delta})''',
+        'self._rpc({"kind": "put", "worker": self.w, "delta": delta})')
+    fs = _findings(tmp_path, DISPATCHER_OK, bad_c)
+    rules = _rules(fs)
+    # the handler's required msg["clock"] read (the seq default) makes
+    # this a PROTO203 too; the seq/clock dedup hole is the PROTO206
+    assert "PROTO206" in rules
+    assert any(f.key == "put.clock" and f.rule == "PROTO206" for f in fs)
+
+
+def test_proto206_idempotent_membership_kind_needs_no_seq(tmp_path):
+    # set.add / discard membership changes are idempotent: replaying
+    # them is harmless, so a seq-less sender is fine
+    d = DISPATCHER_OK + '''\
+            elif kind == "leave":
+                self.members.discard(msg["worker"])
+                send_frame(conn, {"ok": True})
+'''
+    c = CLIENT_OK + '''
+    def leave(self):
+        self._rpc({"kind": "leave", "worker": self.w})
+'''
+    assert _findings(tmp_path, d, c) == []
+
+
+FRAMING_OK = '''
+import struct
+
+def recv_exact(sock, n): ...
+def max_frame(): ...
+
+def recv(sock):
+    (n,) = struct.unpack("!Q", recv_exact(sock, 8))
+    cap = max_frame()
+    if n > cap:
+        raise ValueError(n)
+    return recv_exact(sock, n)
+'''
+
+
+def test_proto207_unchecked_and_absurd_caps(tmp_path):
+    f = tmp_path / "framing.py"
+    # no bounds check at all
+    f.write_text(textwrap.dedent(FRAMING_OK.replace(
+        "    cap = max_frame()\n    if n > cap:\n        raise ValueError(n)\n",
+        "")))
+    fs = P.lint_framing(str(f))
+    assert [x.key for x in fs] == ["unchecked-length"]
+    # literal cap >= 2**31 is still absurd
+    f.write_text(textwrap.dedent(FRAMING_OK.replace(
+        "cap = max_frame()", "cap = 1 << 32")))
+    fs = P.lint_framing(str(f))
+    assert [x.key for x in fs] == ["absurd-cap"]
+    # configurable cap: quiet (the shipped wire.py shape)
+    f.write_text(textwrap.dedent(FRAMING_OK))
+    assert P.lint_framing(str(f)) == []
+
+
+def test_pragma_suppresses_proto_findings(tmp_path):
+    bad = CLIENT_OK + '''
+    def stats(self):
+        return self._rpc({"kind": "stats"})  # static-ok: PROTO201
+'''
+    assert _findings(tmp_path, DISPATCHER_OK, bad) == []
+
+
+# --------------------------------------------------------------------------- #
+# the shipped tree
+# --------------------------------------------------------------------------- #
+
+def test_shipped_tree_has_zero_unbaselined_proto_findings():
+    """The acceptance gate: every PROTO finding on the shipped tree is
+    either fixed or baselined with a written reason."""
+    new = filter_new(P.run_protocol_lint(), load_baseline())
+    assert not new, [f.render() for f in new]
+
+
+def test_shipped_schema_matches_checked_in_golden():
+    """evidence/protocol_schema.json is the reviewed vocabulary; the
+    extraction must reproduce it exactly (the CI --protocols gate)."""
+    golden = P.load_schema()
+    assert golden is not None, "run --refresh-schema and commit it"
+    fresh, _ = P.extract_schema()
+    assert P.diff_schema(golden, fresh) == []
+
+
+def test_shipped_schema_content_highlights():
+    """Headline vocabulary pins, from the GOLDEN (like the HLO contract
+    headline test): the async tier's push is the only non-idempotent
+    kind and carries seq+clock; every dispatcher kind has a sender."""
+    golden = P.load_schema()
+    ps = golden["services"]["param_service"]
+    assert set(ps["kinds"]) == {"hello", "push", "heartbeat", "pull",
+                                "admit", "retire", "clocks", "done", "bye"}
+    assert ps["unhandled_kinds"] == []
+    push = ps["kinds"]["push"]
+    assert push["mutating"] is True
+    assert push["required_fields"] == ["clock", "delta", "worker"]
+    assert set(push["sender_fields"]) >= {"clock", "seq", "delta",
+                                          "worker", "full"}
+    assert [k for k, v in ps["kinds"].items() if v["mutating"]] == ["push"]
+    inf = golden["services"]["inference"]
+    assert set(inf["kinds"]) == {"infer", "stats", "health", "reload",
+                                 "bye"}
+    assert inf["unhandled_kinds"] == []
+    assert "outputs" in inf["kinds"]["infer"]["reply_keys"]
+
+
+def test_schema_diff_detects_vocabulary_drift():
+    golden = P.load_schema()
+    doctored = json.loads(json.dumps(golden))
+    del doctored["services"]["param_service"]["kinds"]["retire"]
+    diffs = P.diff_schema(doctored, golden)
+    assert diffs and any("retire" in d for d in diffs)
+
+
+# --------------------------------------------------------------------------- #
+# collective-schedule consistency gate (pure pieces; the real lowering
+# is exercised by the CI --collectives step and the contract goldens)
+# --------------------------------------------------------------------------- #
+
+_STABLEHLO_SNIPPET = '''
+  %1 = "stablehlo.all_reduce"(%0) <{channel_handle =
+       #stablehlo.channel_handle<handle = 7, type = 1>, replica_groups =
+       dense<[[0, 1], [2, 3]]> : tensor<2x2xi64>}> ({
+  %2 = "stablehlo.reduce_scatter"(%1) <{channel_handle =
+       #stablehlo.channel_handle<handle = 9, type = 1>, replica_groups =
+       dense<[[0, 2], [1, 3]]> : tensor<2x2xi64>, scatter_dimension =
+       0 : i64}> ({
+'''
+
+
+def test_collective_sequence_normalizes_channels():
+    from poseidon_tpu.analysis import contracts as C
+    seq = C.collective_sequence(_STABLEHLO_SNIPPET)
+    # channel ids renumbered by first appearance (7 -> c0, 9 -> c1), so
+    # two participants whose process-global channel counters differ
+    # still compare equal iff their schedules really match
+    assert seq == [
+        "all_reduce|[[0,1],[2,3]]||c0",
+        "reduce_scatter|[[0,2],[1,3]]|scatter_dimension=0|c1",
+    ]
+    shifted = _STABLEHLO_SNIPPET.replace("handle = 7", "handle = 41") \
+                                .replace("handle = 9", "handle = 43")
+    assert C.collective_sequence(shifted) == seq
+
+
+def test_collective_consistency_detects_divergence(monkeypatch):
+    from poseidon_tpu.analysis import contracts as C
+    texts = iter([_STABLEHLO_SNIPPET,
+                  _STABLEHLO_SNIPPET.replace("[[0, 2], [1, 3]]",
+                                             "[[0, 1], [2, 3]]")])
+    monkeypatch.setattr(
+        C, "_lower_mesh_participant",
+        lambda model: (next(texts), None, None, None, None, None))
+    ok, rep = C.collective_consistency(("lenet",), participants=2)
+    assert not ok
+    assert rep["lenet"]["diffs"] and \
+        "diverges at collective #1" in rep["lenet"]["diffs"][0]
+
+
+def test_collective_consistency_refuses_degenerate_extraction(monkeypatch):
+    """If an MLIR printing change moves replica_groups out of the scanned
+    attribute slice, the gate must REFUSE (infra error -> CLI exit 4),
+    never vacuously pass two 'op|?|' sequences as equal."""
+    from poseidon_tpu.analysis import contracts as C
+    degenerate = '%1 = "stablehlo.all_reduce"(%0) ({\n'
+    monkeypatch.setattr(
+        C, "_lower_mesh_participant",
+        lambda model: (degenerate, None, None, None, None, None))
+    with pytest.raises(RuntimeError, match="degenerated"):
+        C.collective_consistency(("lenet",), participants=2)
+
+
+# --------------------------------------------------------------------------- #
+# model checker
+# --------------------------------------------------------------------------- #
+
+def test_model_check_tiny_pinned():
+    """Exact explored-state pin: the reachable state space is a
+    deterministic function of the model — an edit that changes it must
+    re-justify the number here."""
+    res = M.explore(M.tiny_config())
+    assert res.ok, [v for v in res.violations]
+    assert (res.states, res.transitions) == (121, 230)
+
+
+def test_model_check_smoke_acceptance_set():
+    """The ISSUE 15 acceptance: all 2-worker x staleness {0,1,2} configs
+    with one admit AND one retire event (plus a crash/rejoin and a
+    lost-ack replay in the schedule) verify clean, with explored-state
+    counts reported, well under the 60 s CI budget."""
+    t0 = time.time()
+    results, caught = M.run_level("smoke")
+    wall = time.time() - t0
+    assert wall < 60.0, f"smoke level took {wall:.1f}s"
+    by_name = {r.config.name: r for r in results}
+    assert set(by_name) == {"2w-s0-admit-retire-crash",
+                            "2w-s1-admit-retire-crash",
+                            "2w-s2-admit-retire-crash"}
+    for r in results:
+        assert r.ok, (r.config.name, r.violations)
+        assert r.config.admit_id is not None
+        assert r.config.retire_worker is not None
+    # exact state-space pins (regression detectors for silent pruning)
+    assert by_name["2w-s0-admit-retire-crash"].states == 1354
+    assert by_name["2w-s1-admit-retire-crash"].states == 7596
+    assert by_name["2w-s2-admit-retire-crash"].states == 22622
+    assert all(caught.values()), caught
+
+
+def test_seeded_gate_on_raw_mutation_is_caught():
+    """THE acceptance mutation: gating on raw clocks instead of durable
+    clocks (the exact bug PR 12's durable vector exists to prevent) must
+    produce a gate_safety violation with a concrete trace."""
+    res = M.explore(M.smoke_configs()[1], mutation="gate_on_raw")
+    assert not res.ok
+    v = res.violations[0]
+    assert v.invariant == "gate_safety"
+    assert v.trace and any("push_partial" in step for step in v.trace)
+
+
+def test_seeded_no_boundary_flush_breaks_the_sandwich():
+    res = M.explore(M.smoke_configs()[1], mutation="no_boundary_flush")
+    assert not res.ok
+    assert res.violations[0].invariant == "durable_sandwich"
+
+
+def test_seeded_replay_reapply_breaks_exactly_once():
+    res = M.explore(M.smoke_configs()[1], mutation="replay_reapplies")
+    assert not res.ok
+    assert res.violations[0].invariant == "exactly_once"
+
+
+def test_seeded_retire_stays_member_deadlocks():
+    """A retired slot that stays in the gate denominator wedges the
+    survivors — the deadlock detector must find it and name the trace."""
+    caught = M.selftest_mutations()
+    assert caught["retire_stays_member"]
+    cfg = M.Config(name="dl", n_workers=2, staleness=1, n_clocks=4,
+                   retire_worker=1, retire_after=0)
+    res = M.explore(cfg, mutation="retire_stays_member")
+    assert not res.ok
+    assert res.violations[0].invariant == "deadlock"
+
+
+def test_unknown_mutation_rejected():
+    with pytest.raises(ValueError, match="unknown mutation"):
+        M.explore(M.tiny_config(), mutation="bogus")
+
+
+def test_dense_mode_has_no_partial_states():
+    """managed=False (no budget) must reduce to the dense protocol:
+    durable == raw everywhere, strictly fewer states."""
+    cfg = M.Config(name="dense", n_workers=2, staleness=1, n_clocks=3,
+                   managed=False)
+    res = M.explore(cfg)
+    assert res.ok
+    managed = M.explore(M.tiny_config())
+    assert res.states < managed.states
+
+
+# --------------------------------------------------------------------------- #
+# trace conformance: the model vs the real tier
+# --------------------------------------------------------------------------- #
+
+def _zeros(n=65536):
+    return {"l": {"w": np.zeros(n, np.float32)}}
+
+
+def _mk_step(w, n=65536):
+    def step(cache, i):
+        d = (np.arange(n) % (w + 2)).astype(np.float32) * 2.0 ** -12
+        new = {l: {p: cache[l][p] + d for p in cache[l]} for l in cache}
+        return new, 0.5
+    return step
+
+
+@pytest.mark.serving
+def test_trace_conformance_real_two_worker_run():
+    """The harness that keeps the model honest: a REAL 2-worker managed
+    run (tight budget -> partial pushes), plus an elastic admission and
+    a retirement, recorded by the service and replayed through the
+    model's service rules; every client's passed gates must satisfy the
+    durable-staleness bound they were admitted under."""
+    from poseidon_tpu.parallel.async_ssp import (ParamService,
+                                                 run_async_ssp_worker)
+    staleness = 1
+    svc = ParamService(_zeros(), n_workers=2, record_events=True)
+    clients = {"budget_mbps": 0.02, "priority_frac": 0.25,
+               "record_events": True}
+    results = {}
+    threads = []
+
+    def run(w, **kw):
+        results[w] = run_async_ssp_worker(
+            w, 2, _zeros(), _mk_step(w), n_clocks=4, staleness=staleness,
+            service=svc, client_opts=dict(clients), **kw)
+
+    for w in range(2):
+        kw = {"retire_at_clock": 2} if w == 1 else {}
+        t = threading.Thread(target=run, args=(w,), kwargs=kw)
+        t.start()
+        threads.append(t)
+    time.sleep(0.3)
+    tj = threading.Thread(target=run, args=(2,), kwargs={"join": True})
+    tj.start()
+    threads.append(tj)
+    for t in threads:
+        t.join(timeout=60)
+    svc.close()
+
+    events = list(svc.events)
+    counts = M.conform_service_events(events, staleness=staleness,
+                                      n_workers=2)
+    assert counts["push"] > 0
+    assert counts["admit"] == 1
+    assert counts["retire"] == 1
+    # the tight budget really exercised the partial path somewhere
+    assert any(e[0] == "push" and not e[3] for e in events), \
+        "no partial push was recorded — the budget was not tight enough"
+    # (worker, clock) applied exactly once across the whole run
+    applied = [(e[1], e[2]) for e in events
+               if e[0] == "push" and not e[4]]
+    assert len(applied) == len(set(applied))
+
+
+@pytest.mark.serving
+def test_trace_conformance_gate_events():
+    from poseidon_tpu.parallel.async_ssp import (ParamService,
+                                                 run_async_ssp_worker)
+    svc = ParamService(_zeros(1024), n_workers=2, record_events=True)
+    results = {}
+    threads = []
+
+    def run(w):
+        results[w] = run_async_ssp_worker(
+            w, 2, _zeros(1024), _mk_step(w, 1024), n_clocks=3, staleness=0,
+            service=svc,
+            client_opts={"record_events": True})
+
+    for w in range(2):
+        t = threading.Thread(target=run, args=(w,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=60)
+    svc.close()
+    M.conform_service_events(svc.events, staleness=0, n_workers=2)
+    gates = 0
+    for w in range(2):
+        gates += M.conform_gate_events(results[w]["events"],
+                                       staleness=0)["gate"]
+    assert gates >= 4    # both workers passed real gates, all safely
+
+
+def test_conformance_rejects_doctored_traces():
+    ev_dup = [("push", 0, 0, True, False), ("push", 0, 0, True, False)]
+    with pytest.raises(M.TraceConformanceError, match="dedup diverged"):
+        M.conform_service_events(ev_dup, staleness=1, n_workers=1)
+    # boundary clock shipped partial: the force-flush contract broke
+    ev_partial = [("push", 0, 0, True, False), ("push", 0, 1, False,
+                                                False)]
+    with pytest.raises(M.TraceConformanceError, match="force-flush"):
+        M.conform_service_events(ev_partial, staleness=1, n_workers=1)
+    # a gate that passed against a too-stale durable view
+    with pytest.raises(M.TraceConformanceError, match="staleness bound"):
+        M.conform_gate_events([("gate", 0, 5, 1)], staleness=1)
+    assert M.conform_gate_events([("gate", 0, 5, 3)],
+                                 staleness=1) == {"gate": 1}
+
+
+# --------------------------------------------------------------------------- #
+# CLI exit codes (subprocess-pinned, like tests/test_analysis.py)
+# --------------------------------------------------------------------------- #
+
+def _cli(*argv, timeout=180):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "poseidon_tpu.analysis", *argv],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_protocols_clean_on_shipped_tree():
+    r = _cli("--protocols")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "protocol schema: ok" in r.stdout
+
+
+def test_cli_protocols_schema_regression_exits_2(tmp_path):
+    """Exit code 2 — reserved since PR 8 for contract violations — now
+    fired by a protocol-schema regression."""
+    golden = P.load_schema()
+    doctored = json.loads(json.dumps(golden))
+    doctored["services"]["param_service"]["kinds"]["push"][
+        "required_fields"].remove("clock")
+    path = tmp_path / "schema.json"
+    path.write_text(json.dumps(doctored))
+    r = _cli("--protocols", "--schema", str(path))
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "schema drift" in r.stdout
+
+
+def test_cli_refresh_schema_roundtrip(tmp_path):
+    path = tmp_path / "schema.json"
+    r = _cli("--refresh-schema", "--schema", str(path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert path.exists()
+    r = _cli("--protocols", "--schema", str(path))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_model_check_tiny_reports_states():
+    r = _cli("--model-check", "tiny")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "121 states" in r.stdout
+    assert "mutation self-test gate_on_raw: caught" in r.stdout
+
+
+def test_cli_model_check_bad_level_exits_3():
+    r = _cli("--model-check", "bogus")
+    assert r.returncode == 3, r.stdout + r.stderr
+
+
+def test_cli_protocols_with_explicit_paths_still_runs_proto_lint():
+    """--protocols restricted to explicit lint paths must still run the
+    cross-file protocol lint (an invocation that asked for the protocol
+    gate must never read as a passed check that never ran)."""
+    r = _cli("--protocols", "poseidon_tpu/proto/wire.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "protocol schema: ok" in r.stdout
+    # the baselined PROTO205 finding is counted (baselined, not new)
+    assert "1 baselined" in r.stdout or "baselined" in r.stdout

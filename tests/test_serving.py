@@ -299,6 +299,81 @@ def test_malformed_frame_drops_one_connection_not_the_server():
         srv.shutdown()
 
 
+def test_absurd_length_prefix_rejected_before_allocation(monkeypatch):
+    """PROTO207's fix (proto/wire.py): a frame header claiming an absurd
+    length is refused as a FrameError BEFORE any payload allocation —
+    the configurable cap (POSEIDON_MAX_FRAME_BYTES /
+    set_max_frame_bytes), not a multi-gigabyte recv buffer, decides.
+    The offending connection dies; the server keeps serving."""
+    import socket as _socket
+    import struct as _struct
+
+    from poseidon_tpu.proto import wire
+    from poseidon_tpu.serving.client import ServingClient
+
+    # pin the ambient environment: an operator legitimately exporting
+    # the knob must not change what this test asserts about defaults
+    monkeypatch.delenv(wire.MAX_FRAME_ENV, raising=False)
+
+    # unit level: the cap knob resolves override > env > default and the
+    # recv path refuses an over-cap header without reading the payload
+    assert wire.max_frame_bytes() == wire.DEFAULT_MAX_FRAME
+    wire.set_max_frame_bytes(1024)
+    try:
+        assert wire.max_frame_bytes() == 1024
+        with pytest.raises(ValueError):
+            wire.set_max_frame_bytes(0)
+    finally:
+        wire.set_max_frame_bytes(None)
+    monkeypatch.setenv(wire.MAX_FRAME_ENV, "4096")
+    assert wire.max_frame_bytes() == 4096
+    monkeypatch.delenv(wire.MAX_FRAME_ENV)
+
+    srv = _serve()
+    try:
+        sk = _socket.create_connection(srv.addr)
+        # a "legitimate"-looking header claiming a 2**62-byte frame: the
+        # server must drop the connection at the cap check (loudly, as a
+        # bad frame), never attempt the recv
+        sk.sendall(_struct.pack("!Q", 1 << 62))
+        sk.settimeout(5.0)
+        try:
+            assert sk.recv(1) == b""
+        except ConnectionError:
+            pass
+        sk.close()
+        # send-side refusal names the knob instead of wedging the peer —
+        # and is deliberately NOT a ConnectionError/FrameError, so the
+        # reconnect-and-replay machinery can never retry a deterministic
+        # over-cap frame for the whole backoff deadline
+        class _FakeSock:
+            def sendall(self, data):
+                raise AssertionError("oversized frame reached the socket")
+        wire.set_max_frame_bytes(64)
+        try:
+            with pytest.raises(wire.FrameTooLargeError,
+                               match="POSEIDON_MAX_FRAME"):
+                wire.send_frame(_FakeSock(), b"x" * 1024)
+            assert not issubclass(wire.FrameTooLargeError, ConnectionError)
+        finally:
+            wire.set_max_frame_bytes(None)
+        # an unusable env value warns instead of silently reverting
+        monkeypatch.setenv(wire.MAX_FRAME_ENV, "2GB")
+        with pytest.warns(RuntimeWarning, match="not a positive integer"):
+            assert wire.max_frame_bytes() == wire.DEFAULT_MAX_FRAME
+        monkeypatch.delenv(wire.MAX_FRAME_ENV)
+        # the server survived and still serves
+        cli = ServingClient(srv.addr)
+        try:
+            out = cli.infer({"data": _rows(1)})
+            assert out["prob"].shape == (1, 3)
+        finally:
+            cli.close()
+        assert srv.bad_frames >= 1
+    finally:
+        srv.shutdown()
+
+
 def test_unknown_kind_gets_error_reply():
     from poseidon_tpu.proto.wire import recv_frame, send_frame
     import socket as _socket
