@@ -2025,6 +2025,148 @@ def comms_main(argv: list | None = None) -> None:
           "unit": "fraction", "vs_baseline": round(speedup, 3), **cfg})
 
 
+def fabric_main(argv: list | None = None) -> None:
+    """A/B the two-tier fabric's DCN bill against the flat per-process
+    tier on the same loopback service: the SAME global cadence (every
+    participant gates, pushes, and the clock barrier waits for the apply)
+    runs once with one DCN client per PROCESS and once with one client
+    per SLICE leader (parallel/fabric.SliceWorker, ledger mirroring on) —
+    the fabric's thesis is that intra-slice aggregation rides ICI, so the
+    DCN tier carries slices, not processes. Emits ``fabric_vs_flat_step_ms``
+    (fabric per-clock wall; vs_baseline = flat/fabric, >1 = fabric wins)
+    and ``fabric_chaos_recovery_s`` (leader links severed mid-run ->
+    failover -> next push applied). Pure socket tier on loopback, so both
+    lines are CPU proxies; the TPU re-measure over real DCN rides the
+    tunnel queue."""
+    import argparse
+
+    import numpy as np
+
+    from poseidon_tpu.parallel.async_ssp import AsyncSSPClient, ParamService
+    from poseidon_tpu.parallel.fabric import SliceWorker
+    from poseidon_tpu.runtime.faults import FaultProxy
+
+    ap = argparse.ArgumentParser(prog="bench.py fabric")
+    ap.add_argument("--param_kb", type=int, default=256,
+                    help="dense flush size in KiB per DCN participant")
+    ap.add_argument("--clocks", type=int, default=8)
+    ap.add_argument("--staleness", type=int, default=1)
+    ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--procs_per_slice", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    side = int(max(16, (args.param_kb * 256) ** 0.5))
+    params = {"fc": {"w": np.zeros((side, side), np.float32)}}
+    opts = dict(heartbeat_s=0.1, backoff_base_s=0.01, backoff_cap_s=0.1)
+
+    def _delta(rng):
+        return {"fc": {"w": rng.randn(side, side).astype(np.float32)
+                       * 1e-3}}
+
+    def _drain(svc, clock, n, deadline_s=60.0):
+        t0 = time.monotonic()
+        while any(svc.clocks[w] < clock for w in range(n)):
+            if time.monotonic() - t0 > deadline_s:
+                raise RuntimeError(f"clock {clock} never applied")
+            time.sleep(0.001)
+
+    def run_flat() -> float:
+        n = args.slices * args.procs_per_slice
+        svc = ParamService(params, n_workers=n)
+        clients = [AsyncSSPClient(w, ("127.0.0.1", svc.port),
+                                  args.staleness, n_workers=n, **opts)
+                   for w in range(n)]
+        rng = np.random.RandomState(7)
+        try:
+            t0 = time.monotonic()
+            for c in range(args.clocks):
+                for cli in clients:
+                    cli.gate(c)
+                    cli.push(_delta(rng))
+                _drain(svc, c, n)
+            wall = time.monotonic() - t0
+            for cli in clients:
+                cli.mark_done()
+            return wall
+        finally:
+            for cli in clients:
+                cli.close()
+            svc.close()
+
+    def run_fabric() -> float:
+        svc = ParamService(params, n_workers=args.slices)
+        workers = [SliceWorker(s, list(range(args.procs_per_slice)),
+                               ("127.0.0.1", svc.port), args.staleness,
+                               n_slices=args.slices, client_opts=opts)
+                   for s in range(args.slices)]
+        rng = np.random.RandomState(7)
+        try:
+            t0 = time.monotonic()
+            for c in range(args.clocks):
+                for w in workers:
+                    w.gate(c)
+                    w.push(_delta(rng))
+                _drain(svc, c, args.slices)
+            wall = time.monotonic() - t0
+            for w in workers:
+                w.mark_done()
+            return wall
+        finally:
+            for w in workers:
+                w.close()
+            svc.close()
+
+    def run_chaos() -> float:
+        """Leader links severed mid-run; the clock runs from the cut to
+        the successor's next push being APPLIED — reconnect, floor
+        re-derivation, oplog replay and the fresh flush, end to end."""
+        svc = ParamService(params, n_workers=1, liveness_timeout_s=0.0)
+        proxy = FaultProxy(("127.0.0.1", svc.port))
+        w = SliceWorker(0, [0, 1], proxy.addr, args.staleness,
+                        n_slices=1,
+                        client_opts=dict(opts, reconnect_deadline_s=10.0))
+        rng = np.random.RandomState(7)
+        try:
+            w.push(_delta(rng))
+            _drain(svc, 0, 1)
+            t0 = time.monotonic()
+            proxy.sever_group({0})
+            if w.fail_member(0) != "failover":
+                raise RuntimeError("leader kill did not fail over")
+            w.push(_delta(rng))
+            _drain(svc, 1, 1)
+            recovery = time.monotonic() - t0
+            w.mark_done()
+            return recovery
+        finally:
+            w.close()
+            proxy.close()
+            svc.close()
+
+    flat_wall = run_flat()
+    fabric_wall = run_fabric()
+    recovery_s = run_chaos()
+    speedup = flat_wall / fabric_wall if fabric_wall else 0.0
+    cfg = {
+        "cpu_proxy": True,  # loopback socket tier; TPU DCN re-measure
+        #                     rides the tunnel queue (ROADMAP item 4)
+        "param_kb": args.param_kb,
+        "clocks": args.clocks,
+        "staleness": args.staleness,
+        "slices": args.slices,
+        "procs_per_slice": args.procs_per_slice,
+    }
+    emit({"metric": "fabric_vs_flat_step_ms",
+          "value": round(fabric_wall / args.clocks * 1e3, 3),
+          "unit": "ms", "vs_baseline": round(speedup, 3), **cfg,
+          "flat_step_ms": round(flat_wall / args.clocks * 1e3, 3)})
+    # recovery is informational (no baseline exists for it yet), so
+    # vs_baseline rides the step A/B the slice-granular tier bought
+    emit({"metric": "fabric_chaos_recovery_s",
+          "value": round(recovery_s, 3), "unit": "s",
+          "vs_baseline": round(speedup, 3), **cfg})
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
@@ -2034,6 +2176,8 @@ if __name__ == "__main__":
         mesh_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "comms":
         comms_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "fabric":
+        fabric_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "tune":
         tune_main(sys.argv[2:])
     else:

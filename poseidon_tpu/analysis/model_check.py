@@ -8,8 +8,8 @@ per-worker seq high-water mark (PR 1) — and its hardest bugs are
 *interleaving* bugs chaos tests sample but never enumerate. This module
 states the protocol as a small pure-Python transition system and
 EXHAUSTIVELY explores every interleaving for bounded configurations
-(2–3 workers x staleness 0–2 x one admit + one retire + a crash/rejoin
-and lost-ack schedule), checking on every edge:
+(2–3 workers x staleness 0–2 x one admit + one retire + a crash/rejoin,
+lost-ack and leader-failover schedule), checking on every edge:
 
 - **No deadlock**: in every reachable non-terminal state some action is
   enabled (a gate that can never unblock is found, with its trace).
@@ -20,6 +20,15 @@ and lost-ack schedule), checking on every edge:
 - **Read-gate safety**: whenever a gate ADMITS a reader at clock ``c``,
   every gated-on peer's DURABLE clock is ``>= c - s - 1`` — the SSP
   contract stated over bytes actually in the anchor, not raw clocks.
+- **Failover completeness** (two-tier fabric, parallel/fabric.py): a
+  worker here is granularity-agnostic — under ``max_failovers > 0`` it
+  models a whole SPMD slice whose LEADER process dies mid-window. A
+  correct successor re-derives the acked floor from the service and
+  carries the ledgered residual; the seeded mutations drop the residual
+  (``leader_failover_loses_residual`` — caught by the completeness
+  monitor at the next full flush) or restart the seq stream
+  (``double_apply_across_leaders`` — caught by the exactly-once
+  monitor).
 
 The gate *predicate* and the invariant *monitor* are deliberately
 separate code paths, so a seeded mutation of the predicate (gate on raw
@@ -67,7 +76,8 @@ _STATUS = ("unjoined", "active", "crashed", "done", "retired")
 IDLE, GATED = 0, 1
 
 MUTATIONS = ("gate_on_raw", "no_boundary_flush", "replay_reapplies",
-             "retire_stays_member")
+             "retire_stays_member", "leader_failover_loses_residual",
+             "double_apply_across_leaders")
 
 
 @dataclass(frozen=True)
@@ -84,6 +94,12 @@ class Config:
     retire_after: int = 0        # retire once its flushed clock >= this
     max_crashes: int = 0         # crash/rejoin episodes (worker 0 only)
     max_lost_acks: int = 0       # pushes whose ack is lost then replayed
+    # leader-failover episodes (two-tier fabric, parallel/fabric.py): the
+    # worker IS a slice, its leader dies mid-window, a survivor re-elects
+    # and resumes the push stream from the replicated ledger with the
+    # acked floor re-derived from the service. 0 keeps the family off —
+    # pre-fabric configs explore byte-identical state spaces.
+    max_failovers: int = 0
 
 
 @dataclass(frozen=True)
@@ -124,25 +140,30 @@ def is_boundary(clock: int, staleness: int) -> bool:
 # --------------------------------------------------------------------------- #
 # state
 # --------------------------------------------------------------------------- #
-# worker tuple: (status, clock, phase, residual, replay_clock)
+# worker tuple: (status, clock, phase, residual, replay_clock, lost)
 #   clock        — last flushed clock (client-side raw), -1 before any
 #   replay_clock — a pushed clock whose ack was lost, awaiting replay (-1)
+#   lost         — a leader failover DROPPED this worker's residual (the
+#                  seeded loses-residual mutation); the next full flush
+#                  claims completeness the anchor can never have, and the
+#                  _apply_push monitor flags it. Constant False on every
+#                  correct path, so pre-fabric state counts are unchanged.
 # service tuple: (raw, durable, seq) each a per-universe-id tuple, plus
 #   members / failed frozensets
-# budgets: (crashes_left, lost_acks_left, admits_left)
+# budgets: (crashes_left, lost_acks_left, admits_left, failovers_left)
 
-W_STATUS, W_CLOCK, W_PHASE, W_RESID, W_REPLAY = range(5)
+W_STATUS, W_CLOCK, W_PHASE, W_RESID, W_REPLAY, W_LOST = range(6)
 
 
 @dataclass(frozen=True)
 class State:
-    workers: Tuple[Tuple[int, int, int, bool, int], ...]
+    workers: Tuple[Tuple[int, int, int, bool, int, bool], ...]
     raw: Tuple[int, ...]
     durable: Tuple[int, ...]
     seq: Tuple[int, ...]
     members: FrozenSet[int]
     failed: FrozenSet[int]
-    budgets: Tuple[int, int, int]
+    budgets: Tuple[int, int, int, int]
 
 
 def _initial(cfg: Config) -> State:
@@ -151,7 +172,7 @@ def _initial(cfg: Config) -> State:
     for w in range(universe):
         joined = w < cfg.n_workers
         workers.append((ACTIVE if joined else UNJOINED, -1, IDLE, False,
-                        -1))
+                        -1, False))
     return State(
         workers=tuple(workers),
         raw=tuple([-1] * universe),
@@ -160,7 +181,8 @@ def _initial(cfg: Config) -> State:
         members=frozenset(range(cfg.n_workers)),
         failed=frozenset(),
         budgets=(cfg.max_crashes, cfg.max_lost_acks,
-                 1 if cfg.admit_id is not None else 0),
+                 1 if cfg.admit_id is not None else 0,
+                 cfg.max_failovers),
     )
 
 
@@ -170,7 +192,7 @@ def _tset(t: Tuple, i: int, v) -> Tuple:
 
 def _wset(st: State, w: int, **kw) -> Tuple:
     rec = list(st.workers[w])
-    names = ("status", "clock", "phase", "residual", "replay")
+    names = ("status", "clock", "phase", "residual", "replay", "lost")
     for k, v in kw.items():
         rec[names.index(k)] = v
     return _tset(st.workers, w, tuple(rec))
@@ -195,14 +217,27 @@ def _gate_peers(st: State, w: int) -> List[int]:
 
 def _apply_push(st: State, cfg: Config, w: int, clock: int, full: bool,
                 viol: List[Tuple[str, str]],
-                mutation: Optional[str]) -> State:
+                mutation: Optional[str],
+                fresh_seq: bool = False) -> State:
     """The service side of one push RPC (ParamService._serve 'push'):
-    seq-dedup, raw-clock bump, durable bump on full flushes."""
+    seq-dedup, raw-clock bump, durable bump on full flushes.
+    ``fresh_seq`` models a buggy failover successor that restarts its
+    seq stream instead of re-deriving the high-water mark — the push
+    bypasses dedup (the double-apply-across-leaders mutation)."""
     dup = clock <= st.seq[w]
-    if dup and mutation != "replay_reapplies":
+    if full and not dup and st.workers[w][W_LOST]:
+        # completeness monitor: this full flush claims every byte
+        # through ``clock`` is in the anchor, but a leader failover
+        # dropped the slice's parked residual — the durable clock would
+        # advance over bytes that died with the old leader
+        viol.append(("failover_completeness",
+                     f"worker {w} full flush at clock {clock} after a "
+                     f"failover that lost its residual — durable would "
+                     f"cover bytes the dead leader never shipped"))
+    if dup and mutation != "replay_reapplies" and not fresh_seq:
         return st
     if dup:
-        # the seeded no-dedup mutation: apply anyway — the monitor
+        # the seeded no-dedup mutations: apply anyway — the monitor
         # below flags the double application
         viol.append(("exactly_once",
                      f"worker {w} clock {clock} applied twice "
@@ -233,10 +268,10 @@ def _check_global(st: State, cfg: Config) -> Optional[Tuple[str, str]]:
 def _successors(st: State, cfg: Config, mutation: Optional[str]):
     """Yield (label, next_state, [violations]) for every enabled action."""
     s = cfg.staleness
-    crashes_left, acks_left, admits_left = st.budgets
+    crashes_left, acks_left, admits_left, failovers_left = st.budgets
 
     for w, rec in enumerate(st.workers):
-        status, clock, phase, residual, replay = rec
+        status, clock, phase, residual, replay, lost = rec
         target_clocks = cfg.n_clocks
 
         if status == ACTIVE and replay >= 0:
@@ -314,8 +349,51 @@ def _successors(st: State, cfg: Config, mutation: Optional[str]):
                     workers=_wset(st, w, status=CRASHED, residual=False,
                                   replay=-1),
                     failed=st.failed | {w},
-                    budgets=(crashes_left - 1, acks_left, admits_left))
+                    budgets=(crashes_left - 1, acks_left, admits_left,
+                             failovers_left))
                 yield (f"crash({w})", nst, [])
+
+            # leader failover (two-tier fabric): the worker is a SLICE;
+            # its leader process dies between flushes, a survivor
+            # re-elects and resumes from the replicated ledger. The
+            # CORRECT successor re-derives the acked floor from the
+            # service — entries at or below the service's applied clock
+            # are NOT resent (resume_oplog's ``c > applied`` filter), so
+            # an outstanding ack-lost replay is dropped, and the
+            # residual carries over verbatim. The seeded mutations break
+            # exactly one of those two obligations each.
+            if failovers_left > 0 and clock >= 0:
+                nb = (crashes_left, acks_left, admits_left,
+                      failovers_left - 1)
+                if mutation == "leader_failover_loses_residual":
+                    # the successor resumes the clock/seq stream but the
+                    # parked residual died with the old leader; the next
+                    # full flush trips the completeness monitor
+                    nst = replace(st, workers=_wset(
+                        st, w, residual=False, replay=-1,
+                        lost=lost or residual), budgets=nb)
+                    yield (f"failover({w})", nst, [])
+                elif (mutation == "double_apply_across_leaders"
+                        and replay >= 0):
+                    # the successor restarts its seq stream instead of
+                    # re-deriving the high-water mark: the ledgered
+                    # entry whose ack was lost re-applies under a fresh
+                    # seq — the exactly-once monitor flags it
+                    viol = []
+                    nst = _apply_push(st, cfg, w, replay, True, viol,
+                                      mutation, fresh_seq=True)
+                    nst = replace(nst, workers=_wset(nst, w, replay=-1),
+                                  budgets=nb)
+                    yield (f"failover({w})", nst, viol)
+                else:
+                    # correct failover: acked floor from the service, so
+                    # the already-applied ack-lost entry is dropped (the
+                    # service seq dedup would absorb it anyway — this is
+                    # the no-resend fast path), residual survives in the
+                    # ledger
+                    nst = replace(st, workers=_wset(st, w, replay=-1),
+                                  budgets=nb)
+                    yield (f"failover({w})", nst, [])
 
         elif status == ACTIVE and phase == GATED:
             k = clock + 1
@@ -338,7 +416,8 @@ def _successors(st: State, cfg: Config, mutation: Optional[str]):
                     nst,
                     workers=_wset(nst, w, clock=k, phase=IDLE,
                                   residual=False, replay=k),
-                    budgets=(crashes_left, acks_left - 1, admits_left))
+                    budgets=(crashes_left, acks_left - 1, admits_left,
+                             failovers_left))
                 yield (f"push_full_acklost({w},{k})", nst, viol)
             if not must_full:
                 # partial flush: raw advances, durable does not, the
@@ -359,7 +438,8 @@ def _successors(st: State, cfg: Config, mutation: Optional[str]):
             nst = replace(
                 st,
                 workers=_wset(st, w, status=ACTIVE, clock=st.raw[w],
-                              phase=IDLE, residual=False, replay=-1),
+                              phase=IDLE, residual=False, replay=-1,
+                              lost=False),
                 failed=st.failed - {w})
             yield (f"rejoin({w})", nst, [])
 
@@ -379,7 +459,7 @@ def _successors(st: State, cfg: Config, mutation: Optional[str]):
             durable=_tset(st.durable, a, max(st.durable[a], join)),
             seq=_tset(st.seq, a, max(st.seq[a], join)),
             members=st.members | {a},
-            budgets=(crashes_left, acks_left, 0))
+            budgets=(crashes_left, acks_left, 0, failovers_left))
         yield (f"admit({a},{join})", nst, [])
 
 
@@ -461,13 +541,29 @@ def tiny_config() -> Config:
 def smoke_configs() -> List[Config]:
     """The acceptance set: every 2-worker staleness {0,1,2} config with
     one admit AND one retire event, crash/rejoin and a lost-ack replay
-    in the schedule."""
+    in the schedule — plus the two-tier fabric configs, where a worker
+    IS a slice (the model is granularity-agnostic by construction, so
+    slice-level admit/retire is a relabeling) and the leader-failover
+    transition family interleaves with lost acks and partial pushes."""
     out = []
     for s in (0, 1, 2):
         out.append(Config(
             name=f"2w-s{s}-admit-retire-crash", n_workers=2, staleness=s,
             n_clocks=3, managed=True, admit_id=2, retire_worker=1,
             retire_after=1, max_crashes=1, max_lost_acks=1))
+    # slice granularity: one slice admitted mid-run, one retired — the
+    # same elastic machinery the per-process tier uses, exercised under
+    # the fabric's labels (a slice id is just a worker id on the wire)
+    out.append(Config(
+        name="2slice-s1-admit-retire", n_workers=2, staleness=1,
+        n_clocks=3, managed=True, admit_id=2, retire_worker=1,
+        retire_after=1, max_failovers=1))
+    # leader failover mid-window: the failover family crossed with an
+    # ack-lost replay (the exactly-once-across-leaders schedule) and
+    # managed partial pushes (the residual-carryover schedule)
+    out.append(Config(
+        name="2slice-s1-leader-failover", n_workers=2, staleness=1,
+        n_clocks=3, managed=True, max_lost_acks=1, max_failovers=2))
     return out
 
 
@@ -496,6 +592,14 @@ def selftest_mutations(cfg: Optional[Config] = None) -> Dict[str, bool]:
             c = replace(base, name="selftest-retire", retire_worker=1,
                         retire_after=0, n_clocks=4, max_crashes=0,
                         max_lost_acks=0)
+        elif m in ("leader_failover_loses_residual",
+                   "double_apply_across_leaders"):
+            # needs the failover family enabled: a partial push parks a
+            # residual before the failover (loses_residual), and an
+            # ack-lost flush leaves a ledgered entry the buggy successor
+            # re-applies under a fresh seq (double_apply)
+            c = replace(base, name="selftest-failover", max_crashes=0,
+                        max_failovers=1)
         out[m] = not explore(c, mutation=m).ok
     return out
 

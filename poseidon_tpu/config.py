@@ -182,6 +182,42 @@ def set_managed_comm_config(**kwargs) -> None:
 
 
 @dataclass
+class FabricConfig:
+    """Two-tier fabric policy (parallel/fabric.py): an SPMD slice as one
+    elastic SSP worker. The intra-slice tier is the named dp/fsdp/tp mesh
+    (synchronous, ICI-speed); the cross-slice tier is the async-SSP DCN
+    protocol spoken by ONE leader process per slice. These knobs govern
+    the slice-granular robustness machinery only — per-process async-SSP
+    mode ignores them entirely."""
+
+    # mirror the leader's oplog (clock, pending-as-sent, residual) into
+    # the slice ledger after every push; False trades failover coverage
+    # (a successor resumes from the service anchor only) for zero copies
+    ledger_mirroring: bool = True
+    # a slice that shrinks below this many live members retires instead
+    # of re-cutting its inner data shard (1 = never auto-retire)
+    min_members: int = 1
+    # seconds a successor leader waits for the service to register the
+    # dead leader's disconnect before re-dialing (0 = dial immediately;
+    # the hello/admit path is idempotent either way)
+    failover_grace_s: float = 0.0
+
+
+_fabric = FabricConfig()
+
+
+def fabric_config() -> FabricConfig:
+    return _fabric
+
+
+def set_fabric_config(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_fabric, k):
+            raise AttributeError(k)
+        setattr(_fabric, k, v)
+
+
+@dataclass
 class FleetConfig:
     """Serving-fleet policy (serving/fleet.py): how many replicas the
     front door fans out to, where they pin, and the health/reload knobs.

@@ -144,7 +144,7 @@ import random
 import socket
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -1440,6 +1440,86 @@ class AsyncSSPClient:
         with self._pending_lock:
             self._pending = []
         return snap["anchor"], dict(self.clocks)
+
+    def poll_view(self) -> Dict[int, int]:
+        """One ``clocks`` RPC + view absorb (the same exchange the gate
+        polls with): returns the service's raw applied-clock table. A
+        successor slice leader re-derives its acked floor from this — the
+        service, not the dead leader's memory, is the source of truth for
+        which clocks landed."""
+        resp = self._pull_rpc({"kind": "clocks"})
+        self._absorb_view(resp)
+        return dict(self.clocks)
+
+    def resume_oplog(self, clock: int,
+                     pending: Sequence[Tuple[int, Dict, bool]],
+                     residual: Optional[Dict]) -> int:
+        """Leader-failover resume (parallel/fabric.py): install a slice's
+        replicated ledger into a FRESH client for the same worker id and
+        resume its push stream exactly where the dead leader left it.
+
+        The acked floor is re-derived from the SERVICE (pushes are applied
+        in clock order, so every ledgered clock at or below the service's
+        raw applied clock landed; anything above must replay). The replay
+        rides the ordinary sender queue with ``seq == clock``, so a push
+        whose ack died with the old leader dedups server-side — the seq
+        high-water mark makes failover exactly-once with zero new
+        protocol cases. The residual (managed communication's deferred
+        complement) is restored verbatim: the bytes a partial push parked
+        are slice state, not a single process's, and losing them at
+        failover is exactly the seeded model-checker mutation
+        ``leader_failover_loses_residual``. Returns the acked floor.
+
+        Must be called before the first push on this client (a fresh
+        client off the constructor — the fabric's failover path)."""
+        applied = self.poll_view().get(self.worker, -1)
+        self._acked_clock = applied
+        self.clock = max(clock, applied)
+        self._residual = (_tree_copy(residual)
+                          if residual is not None else None)
+        backlog = [(c, _tree_copy_any(d), f) for c, d, f in pending
+                   if c > applied]
+        backlog.sort(key=lambda e: e[0])
+        with self._pending_lock:
+            self._pending = list(backlog)
+        for item in backlog:
+            self._q.put(item)
+        return applied
+
+    def snapshot_oplog(self) -> Tuple[int, List[Tuple[int, Dict, bool]],
+                                      Optional[Dict]]:
+        """Replication hook for parallel/fabric.py: a deep copy of the
+        state a successor leader needs to resume this push stream —
+        (clock, pending payloads AS SENT, residual). Mirrored into the
+        slice ledger after every push; in a real pod the copy rides ICI
+        to the surviving members, in-process it is shared memory. Must be
+        called from the train thread (the residual's owner)."""
+        with self._pending_lock:
+            pending = [(c, _tree_copy_any(d), f)
+                       for c, d, f in self._pending]
+        resid = (_tree_copy(self._residual)
+                 if self._residual is not None else None)
+        return self.clock, pending, resid
+
+    def abandon(self) -> None:
+        """Kill this client AS IF its process died: stop the sender and
+        close the raw sockets with no residual flush, no drain, no bye.
+        The failover path in parallel/fabric.py uses this to retire the
+        DEAD leader's client object — a clean close() would flush state a
+        dead process could never have flushed, quietly shrinking the very
+        window the ledger replay exists to cover. The service sees an
+        ordinary disconnect; the successor's hello un-evicts the slice."""
+        self._stop.set()
+        for s in (self._push_sock, self._pull_sock):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._sender.join(timeout=5.0)
 
     def leave(self) -> None:
         """Deliberate scale-down: flush any deferred residual (a retiring

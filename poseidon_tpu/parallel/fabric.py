@@ -1,0 +1,421 @@
+"""Two-tier training fabric: an SPMD slice as one elastic SSP worker.
+
+Poseidon's thesis is hierarchical sync — fast synchronous math inside a
+machine, managed bounded-staleness communication between machines
+(PAPER.md) — and this module composes the repo's two halves at pod
+scale. INSIDE a slice, the named dp/fsdp/tp mesh (parallel/spmd.py) runs
+the full step synchronously over the slice's own devices: ICI-speed
+collectives, sharded-resident state, one compiled program. BETWEEN
+slices, one designated LEADER process per slice speaks the existing
+AsyncSSPClient protocol on the DCN tier: arena-delta exchange rides the
+managed-communication path verbatim (bandwidth budget, TOPK partial
+pushes with exact residual, durable-clock gates), SSP staleness bounds
+cross-slice drift, and the admit/retire/rejoin machinery now admits and
+retires WHOLE slices mid-run. The wire protocol is untouched — a slice
+id is just a worker id to the service — so every exactly-once, eviction
+and gate property the protocol checker verifies carries over by
+config, not by new code (analysis/model_check.py's slice-granularity
+configs).
+
+The robustness core is slice-granular failure domains:
+
+- **Leader failover.** The leader mirrors its push oplog — (clock,
+  pending payloads AS SENT, residual) — into a :class:`SliceLedger`
+  after every flush (shared memory in-process; ICI replication on a real
+  pod). When the leader dies, a surviving member re-elects (min live
+  rank), RE-DERIVES the acked floor from the service's applied-clock
+  table (the service, not the dead leader's memory, is the source of
+  truth), and resumes the push stream via
+  ``AsyncSSPClient.resume_oplog``: ledger entries above the floor
+  replay with their original ``seq == clock``, so a push whose ack died
+  with the old leader dedups server-side — exactly-once holds across
+  leader death, not just worker death. The residual rides the ledger
+  too: the bytes a partial push parked are SLICE state, and dropping
+  them at failover is precisely the seeded model-checker mutation
+  ``leader_failover_loses_residual``.
+
+- **Shrink / retire.** A slice that loses a non-leader member re-cuts
+  its INNER data shard over the survivors (data/workload.member_shard
+  keyed by live member ranks) and keeps training; below
+  ``FabricConfig.min_members`` it retires its DCN slot cleanly (flush +
+  drain + retire RPC) so the survivors' gates stop waiting on it.
+
+- **Slice join.** A joining slice warm-starts its compiled step from
+  the persistent compile cache and anchors at the service's rendezvous
+  clock — the ordinary elastic admit, at slice granularity.
+
+Data is sharded TWO-TIER: the outer cut is by live slice ids (each
+slice = one member of the DCN job), the inner cut is by live member
+ranks within the slice; :func:`two_tier_shard` composes both into one
+record-space shard so any membership event — slice admitted, slice
+retired, member lost — re-cuts the same global permutation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..config import fabric_config
+from ..data.workload import Shard, member_shard
+from .async_ssp import AsyncSSPClient
+
+Tree = Dict[str, Dict[str, np.ndarray]]
+
+# the arena-delta wire form: the whole parameter arena as ONE flat leaf,
+# so a budget-tight TOPK partial push ranks magnitudes GLOBALLY over the
+# slice's entire update instead of per-leaf (the managed-communication
+# payload splitter iterates leaves; one leaf = one global ranking)
+ARENA_LAYER = "arena"
+ARENA_PARAM = "flat"
+
+
+# --------------------------------------------------------------------------- #
+# arena-delta helpers (core/arena.py sync hooks)
+# --------------------------------------------------------------------------- #
+
+def arena_tree(flat: np.ndarray) -> Tree:
+    """Wrap a flat f32 arena buffer as the one-leaf exchange tree."""
+    return {ARENA_LAYER: {ARENA_PARAM: np.asarray(flat, np.float32)}}
+
+
+def arena_flat(tree: Tree) -> np.ndarray:
+    """Unwrap the one-leaf exchange tree back to the flat buffer."""
+    return np.asarray(tree[ARENA_LAYER][ARENA_PARAM], np.float32)
+
+
+def pack_arena_delta(layout, cur_params: Dict,
+                     prev_flat: np.ndarray) -> Tuple[Tree, np.ndarray]:
+    """Pack a slice's parameter tree through its ArenaLayout and diff it
+    against the previous packed view: returns (delta exchange tree, new
+    flat view). The DCN tier then pushes one flat vector per clock — the
+    same buffer the intra-slice fsdp tier reduce-scatters — so the two
+    tiers share one layout and TOPK prioritization ranks globally."""
+    flat = np.asarray(layout.pack(cur_params), np.float32)
+    return arena_tree(flat - prev_flat), flat
+
+
+def unpack_arena_cache(layout, cache: Tree) -> Dict:
+    """The inverse hook: a refreshed DCN cache (one flat leaf) back into
+    the per-leaf parameter tree the compiled step consumes."""
+    return layout.unpack(arena_flat(cache))
+
+
+# --------------------------------------------------------------------------- #
+# two-tier data sharding
+# --------------------------------------------------------------------------- #
+
+def two_tier_shard(live_slices: Sequence[int], slice_id: int,
+                   members: Sequence[int], rank: int) -> Shard:
+    """Compose the outer (by live slice id) and inner (by live member
+    rank within the slice) cuts into one record-space shard. Both cuts
+    are membership-set-keyed (data/workload.member_shard), so every
+    process derives the identical partition from the shared view alone:
+    slice admit/retire re-cuts the outer tier, a member loss re-cuts
+    only the inner tier of the slice that shrank."""
+    outer = member_shard(live_slices, slice_id)
+    inner = member_shard(members, rank)
+    return Shard(outer.index * inner.count + inner.index,
+                 outer.count * inner.count)
+
+
+def slice_device_block(devices: Sequence, slice_id: int,
+                       n_devices: int) -> List:
+    """Slice ``slice_id``'s contiguous device block for its sub-mesh —
+    devices [slice_id * n_devices, (slice_id + 1) * n_devices) of the
+    visible set, mirroring the contiguous-rank contract in
+    runtime/cluster.slice_world. Fails loudly when the block would run
+    off the end (an overlapping or oversubscribed slice layout)."""
+    lo, hi = slice_id * n_devices, (slice_id + 1) * n_devices
+    if hi > len(devices):
+        raise ValueError(
+            f"slice {slice_id} wants devices [{lo}, {hi}) but only "
+            f"{len(devices)} are visible — slice blocks are contiguous "
+            f"and disjoint by contract")
+    return list(devices[lo:hi])
+
+
+def slice_submesh(mesh_cfg, slice_id: int, devices=None):
+    """The slice's own named dp/fsdp/tp mesh over its contiguous device
+    block (parallel/spmd.named_mesh with an explicit device subset) —
+    the intra-slice synchronous tier. Imported lazily: everything else
+    in this module is jax-free, and the ledger/failover machinery must
+    stay importable from socket-tier processes."""
+    import jax
+
+    from .spmd import named_mesh
+    devs = devices if devices is not None else jax.devices()
+    block = slice_device_block(devs, slice_id, mesh_cfg.n_devices)
+    return named_mesh(mesh_cfg, devices=block)
+
+
+# --------------------------------------------------------------------------- #
+# the replicated slice ledger
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class _LedgerState:
+    clock: int = -1
+    pending: List[Tuple[int, Dict, bool]] = field(default_factory=list)
+    residual: Optional[Dict] = None
+    mirrors: int = 0
+
+
+class SliceLedger:
+    """The slice's replicated push-stream state: the leader's clock, its
+    un-acked pending payloads AS SENT, and the managed-communication
+    residual. In-process this is a lock-guarded shared object (the test
+    world's stand-in for ICI replication to the surviving members); the
+    REPLICATION POINT is the contract — ``mirror()`` runs after every
+    push returns, so at any leader death the ledger holds every payload
+    the dead leader may have flushed, and nothing newer. What the ledger
+    does NOT hold is ack state: the acked floor is re-derived from the
+    service at failover (resume_oplog), which is what makes a stale
+    mirror safe — replaying an already-applied clock dedups by seq."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._s = _LedgerState()
+
+    def mirror(self, client: AsyncSSPClient) -> None:
+        """Snapshot the leader client's oplog into the ledger (deep
+        copies — the ledger must survive the client object)."""
+        clock, pending, residual = client.snapshot_oplog()
+        with self._lock:
+            self._s.clock = clock
+            self._s.pending = pending
+            self._s.residual = residual
+            self._s.mirrors += 1
+
+    def snapshot(self) -> Tuple[int, List[Tuple[int, Dict, bool]],
+                                Optional[Dict]]:
+        """(clock, pending, residual) for a successor's resume_oplog."""
+        with self._lock:
+            return (self._s.clock, list(self._s.pending), self._s.residual)
+
+    @property
+    def mirrors(self) -> int:
+        with self._lock:
+            return self._s.mirrors
+
+
+# --------------------------------------------------------------------------- #
+# the slice worker
+# --------------------------------------------------------------------------- #
+
+class SliceWorker:
+    """One SPMD slice acting as ONE elastic SSP worker.
+
+    The DCN identity is the SLICE id: the ParamService sees `worker ==
+    slice_id`, gates and shards by slice membership, and every protocol
+    property (exactly-once by seq, durable-clock gating, eviction,
+    admit/retire) applies at slice granularity with zero wire changes.
+    Exactly one member process — the leader, min live rank — owns the
+    client; the others run the synchronous intra-slice tier and hold the
+    ledger replica.
+
+    Membership events, driven by the harness/launcher via
+    :meth:`fail_member`:
+
+    - non-leader death  -> inner data re-cut (``data_shard`` re-keys),
+      or clean retire when the slice falls below
+      ``FabricConfig.min_members``;
+    - leader death      -> re-elect min live rank, abandon the dead
+      client raw (no flush, no bye — a dead process flushed nothing),
+      build a FRESH client for the same slice id and resume the ledger
+      via ``resume_oplog`` (acked floor re-derived from the service);
+    - last member death -> the slice is simply gone; the service evicts
+      it by disconnect/liveness and the survivors' gates move on.
+    """
+
+    def __init__(self, slice_id: int, members: Sequence[int],
+                 addr: Tuple[str, int], staleness: int,
+                 n_slices: int = 0,
+                 client_opts: Optional[Dict] = None,
+                 ledger: Optional[SliceLedger] = None):
+        if not members:
+            raise ValueError(f"slice {slice_id}: empty member list")
+        self.slice_id = slice_id
+        self.addr = addr
+        self.staleness = staleness
+        self.n_slices = n_slices
+        self._client_opts = dict(client_opts or {})
+        self.live: Set[int] = set(members)
+        self.ledger = ledger if ledger is not None else SliceLedger()
+        self._cfg = fabric_config()
+        self.failovers = 0
+        self.retired = False
+        self.client = self._make_client()
+
+    # -- identity ------------------------------------------------------ #
+    @property
+    def leader(self) -> int:
+        """The designated DCN speaker: min live rank (deterministic —
+        every surviving member elects the same successor with no
+        coordination beyond the shared live set)."""
+        if not self.live:
+            raise RuntimeError(f"slice {self.slice_id} has no live members")
+        return min(self.live)
+
+    def _make_client(self) -> AsyncSSPClient:
+        return AsyncSSPClient(self.slice_id, self.addr, self.staleness,
+                              n_workers=self.n_slices,
+                              **self._client_opts)
+
+    # -- DCN tier (leader-only, ledger-mirrored) ----------------------- #
+    def join(self) -> Tuple[Dict, Dict[int, int]]:
+        """Rendezvous the slice into the live job (admit RPC; idempotent
+        for launch-roster slices). Returns (anchor cache, clock table) —
+        the joining slice's warm-start state."""
+        return self.client.join()
+
+    def push(self, delta: Dict, force_full: bool = False) -> int:
+        """Flush one clock's slice update, then mirror the oplog to the
+        ledger — the replication point the failover contract is built
+        on. Push first, mirror second: a mirror that raced AHEAD of the
+        push could hold a clock the send loop never saw, and a successor
+        would replay a payload the service might legitimately apply
+        twice under a fresh seq."""
+        clock = self.client.push(delta, force_full=force_full)
+        if self._cfg.ledger_mirroring:
+            self.ledger.mirror(self.client)
+        return clock
+
+    def gate(self, clock: int, **kw) -> float:
+        return self.client.gate(clock, **kw)
+
+    def refresh(self) -> Tuple[Dict, Dict[int, int]]:
+        return self.client.refresh()
+
+    def retire(self) -> None:
+        """Deliberate whole-slice scale-down: residual flush + drain +
+        retire RPC, so the surviving slices' gates stop waiting on this
+        one immediately."""
+        self.client.leave()
+        self.retired = True
+
+    def mark_done(self) -> None:
+        self.client.mark_done()
+
+    def close(self) -> None:
+        self.client.close()
+
+    # -- membership events --------------------------------------------- #
+    def data_shard(self, live_slices: Sequence[int], rank: int) -> Shard:
+        """Rank ``rank``'s record-space shard under the CURRENT two-tier
+        membership (outer: live slice ids; inner: this slice's live
+        ranks)."""
+        return two_tier_shard(live_slices, self.slice_id,
+                              sorted(self.live), rank)
+
+    def fail_member(self, rank: int) -> str:
+        """A member process died. Returns the event this slice took:
+        ``"shrunk"`` (inner re-cut), ``"failover"`` (leader re-elected,
+        push stream resumed), ``"retired"`` (fell below min_members and
+        left cleanly), or ``"dead"`` (no members remain)."""
+        if rank not in self.live:
+            raise ValueError(
+                f"slice {self.slice_id}: rank {rank} is not live "
+                f"({sorted(self.live)})")
+        was_leader = rank == self.leader
+        self.live.discard(rank)
+        if not self.live:
+            # no survivor to run the protocol; the service will evict
+            # the slice by disconnect/liveness detection
+            return "dead"
+        if len(self.live) < max(1, self._cfg.min_members):
+            if was_leader:
+                self._failover()
+            self.retire()
+            return "retired"
+        if was_leader:
+            self._failover()
+            return "failover"
+        return "shrunk"
+
+    def _failover(self) -> None:
+        """Leader death: the new leader (already elected — min live
+        rank) takes over the slice's DCN stream. The dead client is
+        abandoned RAW — no residual flush, no drain, no bye; a dead
+        process sent nothing — and a fresh client resumes the ledger:
+        acked floor from the service's applied table, pending entries
+        above it replayed with their original seqs (server-side dedup
+        makes the ack-lost overlap exactly-once), residual restored
+        verbatim so no parked bytes die with the old leader."""
+        dead = self.client
+        dead.abandon()
+        if self._cfg.failover_grace_s > 0:
+            time.sleep(self._cfg.failover_grace_s)
+        clock, pending, residual = self.ledger.snapshot()
+        self.client = self._make_client()
+        self.client.resume_oplog(clock, pending, residual)
+        if self._cfg.ledger_mirroring:
+            # re-mirror from the successor: the ledger's epoch now
+            # matches the live client (mirrors counter = audit trail)
+            self.ledger.mirror(self.client)
+        self.failovers += 1
+
+
+# --------------------------------------------------------------------------- #
+# slice driver (the run_async_ssp_worker analog at slice granularity)
+# --------------------------------------------------------------------------- #
+
+def run_slice_worker(
+    slice_worker: SliceWorker,
+    params: Dict,
+    local_step: Callable[[Dict, int], Tuple[Dict, float]],
+    n_clocks: int,
+    sync_every: int = 1,
+    join: bool = False,
+    retire_at_clock: Optional[int] = None,
+    fail_at: Optional[Dict[int, Sequence[int]]] = None,
+) -> Dict:
+    """Drive one slice through ``n_clocks`` DCN clocks: gate -> step(s)
+    -> push -> refresh, with membership events injected at clock
+    boundaries (``fail_at``: clock -> ranks to fail BEFORE that clock's
+    step — the deterministic chaos hook the fabric tests replay
+    bitwise). ``local_step(cache, step_index) -> (new_params, loss)`` is
+    the slice's compiled SPMD step; the flushed increment is the
+    parameter delta it produced, exactly the per-process driver's
+    contract but with the slice's sub-mesh inside the step. Returns the
+    final cache + telemetry."""
+    from .async_ssp import _tree_copy, _tree_sub
+
+    w = slice_worker
+    losses: List[float] = []
+    events: List[Tuple[int, str]] = []
+    start_clock = 0
+    if join:
+        cache, _ = w.join()
+        start_clock = w.client.clock + 1
+    else:
+        cache = _tree_copy(params)
+    step_i = 0
+    for clock in range(start_clock, n_clocks):
+        for rank in (fail_at or {}).get(clock, ()):
+            events.append((clock, f"{w.fail_member(rank)}:{rank}"))
+            if w.retired or not w.live:
+                return {"cache": cache, "losses": losses, "events": events,
+                        "clock": w.client.clock, "slice_id": w.slice_id,
+                        "failovers": w.failovers, "retired": w.retired}
+        w.gate(clock)
+        prev = _tree_copy(cache)
+        for _ in range(sync_every):
+            cache, loss = local_step(cache, step_i)
+            step_i += 1
+            losses.append(float(loss))
+        w.push(_tree_sub(cache, prev))
+        cache, _ = w.refresh()
+        if retire_at_clock is not None and clock >= retire_at_clock:
+            w.retire()
+            events.append((clock, "retired:planned"))
+            break
+    if not w.retired:
+        w.mark_done()
+    return {"cache": cache, "losses": losses, "events": events,
+            "clock": w.client.clock, "slice_id": w.slice_id,
+            "failovers": w.failovers, "retired": w.retired}

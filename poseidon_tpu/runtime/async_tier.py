@@ -51,7 +51,7 @@ class AsyncSSPTier:
                  comm_budget_mbps: Optional[float] = None,
                  comm_priority_frac: Optional[float] = None,
                  comm_adaptive: Optional[bool] = None):
-        self.rank, self.n_procs, coord = env_world()
+        self.rank, self.n_procs, coord = self._identity()
         self.staleness = staleness
         self.sync_every = max(1, sync_every)
         # managed communication (SSPAggr): None knobs resolve against the
@@ -145,6 +145,19 @@ class AsyncSSPTier:
             f"{host}:{port}{managed}", rank=self.rank)
 
     # ------------------------------------------------------------------ #
+    def _identity(self) -> Tuple[int, int, Optional[str]]:
+        """(worker id, worker count, coordinator) — the DCN-tier identity
+        this process speaks the protocol under. The base tier is the
+        per-process mode (one launcher rank = one SSP worker);
+        :class:`FabricTier` overrides it so one SLICE = one worker."""
+        return env_world()
+
+    def _mirror(self) -> None:
+        """Post-push replication hook: a no-op in per-process mode (a
+        worker's oplog dies with it — the bounded-loss failure model);
+        the fabric tier mirrors the leader's oplog to the slice ledger
+        here so failover can resume the push stream exactly-once."""
+
     def data_shard(self) -> Shard:
         """This worker's record-space shard under the CURRENT member list
         (data/workload.member_shard keyed by membership, not launch
@@ -205,6 +218,7 @@ class AsyncSSPTier:
         delta = {l: {p: cur[l][p] - self._prev[l][p] for p in ps}
                  for l, ps in cur.items()}
         clock = self.client.push(delta)
+        self._mirror()
         # exception safety, not data flow: refresh() below replaces _prev,
         # but if it raises (permanently dead tier) a retrying caller must
         # never re-derive — and double-push — the delta just enqueued
@@ -217,6 +231,7 @@ class AsyncSSPTier:
             # tick — no parameter-sized zero trees on the wire or in the
             # client's replay oplog)
             clock = self.client.push({})
+            self._mirror()
             self._iters_since -= self.sync_every
         cache, _ = self.client.refresh()
         self._prev = cache
@@ -276,3 +291,62 @@ class AsyncSSPTier:
                                            for k, v in out.items()),
             rank=self.rank)
         return out
+
+
+class FabricTier(AsyncSSPTier):
+    """Two-tier fabric engine hook (``train --async_ssp --slice``): this
+    process is the designated LEADER of an SPMD slice, and the DCN
+    identity it speaks the protocol under is the SLICE id — the
+    ParamService gates, shards and admits/retires by slice membership
+    (parallel/fabric.py). Everything else is the inherited tier: the
+    inherited ``data_shard`` keyed by slice-id members IS the outer cut
+    of the two-tier partition (the inner cut happens inside the slice's
+    own SPMD step, which shards the batch over its dp/fsdp sub-mesh),
+    and the flush cadence/gates/telemetry carry over unchanged.
+
+    Only the leader runs this tier: a multi-process slice's non-leader
+    ranks run the synchronous intra-slice program under the slice's own
+    ``jax.distributed`` world and never dial the DCN service — launching
+    one with ``--slice`` is refused loudly (a second client under the
+    same slice id would fork the seq stream and break exactly-once).
+    The leader mirrors its push oplog into the slice ledger after every
+    flush; on leader death a surviving member re-launches with the same
+    slice env and resumes via ``AsyncSSPClient.resume_oplog``."""
+
+    def __init__(self, params: Dict, staleness: int, **kwargs):
+        from ..config import fabric_config
+        from ..parallel.fabric import SliceLedger
+        from .cluster import slice_world
+        sw = slice_world(n_visible_devices=jax.device_count())
+        if sw is None:
+            raise ValueError(
+                "--slice requires the slice env contract: set "
+                "POSEIDON_SLICE_ID and POSEIDON_SLICE_SIZE "
+                "(runtime/cluster.slice_world)")
+        if not sw.is_leader:
+            raise ValueError(
+                f"rank-in-slice {sw.rank_in_slice} of slice {sw.slice_id} "
+                f"is not the leader: only the leader (rank-in-slice 0) "
+                f"speaks the DCN protocol — a second client under slice id "
+                f"{sw.slice_id} would fork the push-seq stream and break "
+                f"exactly-once. Non-leader ranks run the intra-slice SPMD "
+                f"program only.")
+        self.slice_assignment = sw
+        self.ledger = SliceLedger()
+        self._fabric_cfg = fabric_config()
+        super().__init__(params, staleness, **kwargs)
+        log(f"fabric tier: slice {sw.slice_id} of {self.n_procs} "
+            f"({sw.slice_size} process(es)/slice, leader rank-in-slice 0) "
+            f"speaking the DCN tier as worker {self.rank}", rank=0)
+
+    def _identity(self) -> Tuple[int, int, Optional[str]]:
+        """The slice IS the worker: id = slice_id, count = whole slices
+        in the roster. The coordinator address still comes from the
+        process env (the service rides the same rendezvous host)."""
+        _, _, coord = env_world()
+        sw = self.slice_assignment
+        return sw.slice_id, sw.n_slices, coord
+
+    def _mirror(self) -> None:
+        if self._fabric_cfg.ledger_mirroring:
+            self.ledger.mirror(self.client)

@@ -117,7 +117,15 @@ def _engine_from_args(args, phase_nets=True):
             async_cfg["comm_priority_frac"] = v
         if getattr(args, "comm_adaptive", False):
             async_cfg["comm_adaptive"] = True
+        # two-tier fabric: this process leads an SPMD slice and the DCN
+        # worker identity is the slice id (runtime/async_tier.FabricTier;
+        # needs the POSEIDON_SLICE_ID/POSEIDON_SLICE_SIZE env contract)
+        if getattr(args, "slice", False):
+            async_cfg["slice"] = True
         staleness = 0
+    elif getattr(args, "slice", False):
+        raise SystemExit("--slice composes the two-tier fabric on top of "
+                         "the async tier; it requires --async_ssp")
     metrics_port = getattr(args, "metrics_port", -1)
     spd = getattr(args, "steps_per_dispatch", None)
     return Engine(sp, comm=comm, mesh=mesh, mesh_cfg=mesh_cfg,
@@ -1041,6 +1049,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "jax.distributed world, no cross-process barrier")
     t.add_argument("--async_sync_every", type=int, default=1,
                    help="optimizer iterations per async-SSP flush clock")
+    t.add_argument("--slice", action="store_true",
+                   help="two-tier fabric (parallel/fabric.py): this "
+                        "process LEADS an SPMD slice and the async-SSP "
+                        "worker identity is the SLICE id — synchronous "
+                        "dp/fsdp/tp math inside the slice, bounded-"
+                        "staleness exchange between slices, admit/retire/"
+                        "failover at slice granularity. Requires "
+                        "--async_ssp plus the POSEIDON_SLICE_ID/"
+                        "POSEIDON_SLICE_SIZE env contract; only the "
+                        "slice leader (rank-in-slice 0) may run it")
     t.add_argument("--comm_budget_mbps", type=float, default=-1.0,
                    help="managed communication (SSPAggr): per-link "
                         "bandwidth budget in Mbit/s for the async-SSP "

@@ -56,6 +56,106 @@ def is_elastic_joiner(rank: int, n_procs: int) -> bool:
 
 
 @dataclass(frozen=True)
+class SliceAssignment:
+    """This process's place in the two-tier fabric (parallel/fabric.py):
+    which slice it belongs to, how many processes the slice spans, and its
+    rank within the slice (0 = the designated DCN leader)."""
+
+    slice_id: int
+    slice_size: int
+    rank_in_slice: int
+    n_slices: int        # whole slices in the launch roster
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank_in_slice == 0
+
+    @property
+    def is_joiner_slice(self) -> bool:
+        """The slice sits outside the launch roster — the slice-granular
+        analog of :func:`is_elastic_joiner` (admitted mid-run, not
+        launched)."""
+        return self.slice_id >= self.n_slices
+
+
+def slice_env(n_visible_devices: Optional[int] = None
+              ) -> Optional[Tuple[int, int]]:
+    """(slice_id, slice_size) from POSEIDON_SLICE_ID / POSEIDON_SLICE_SIZE,
+    or None when neither is set — plain per-process mode stays byte-for-
+    byte unchanged. Refusals are loud and permanent (a half-set or
+    impossible slice contract would otherwise become N silently
+    mis-sharded runs):
+
+    - one variable set without the other;
+    - slice_size < 1 or slice_id < 0;
+    - a slice larger than the visible device count (every member pins at
+      least one device, so slice_size > n_visible_devices cannot be
+      scheduled; pass the count from the jax side — this module stays
+      jax-free)."""
+    sid = os.environ.get("POSEIDON_SLICE_ID")
+    ssz = os.environ.get("POSEIDON_SLICE_SIZE")
+    if sid is None and ssz is None:
+        return None
+    if sid is None or ssz is None:
+        raise ValueError(
+            "POSEIDON_SLICE_ID and POSEIDON_SLICE_SIZE must be set "
+            f"together (got SLICE_ID={sid!r}, SLICE_SIZE={ssz!r}); the "
+            "slice contract is all-or-nothing")
+    slice_id, slice_size = int(sid), int(ssz)
+    if slice_id < 0:
+        raise ValueError(f"POSEIDON_SLICE_ID must be >= 0, got {slice_id}")
+    if slice_size < 1:
+        raise ValueError(
+            f"POSEIDON_SLICE_SIZE must be >= 1, got {slice_size}")
+    if n_visible_devices is not None and slice_size > n_visible_devices:
+        raise ValueError(
+            f"slice {slice_id} spans {slice_size} processes but only "
+            f"{n_visible_devices} device(s) are visible — a slice member "
+            f"cannot share a device; shrink POSEIDON_SLICE_SIZE or widen "
+            f"the device set")
+    return slice_id, slice_size
+
+
+def slice_world(n_visible_devices: Optional[int] = None
+                ) -> Optional[SliceAssignment]:
+    """The full slice contract for THIS process, or None when the slice
+    env is unset. Slices own CONTIGUOUS rank blocks — slice k is exactly
+    processes [k*size, (k+1)*size) — so every process can derive the
+    whole assignment from its own env with no coordination, and any two
+    processes that disagree are refused loudly:
+
+    - a rank outside its declared slice's block is an OVERLAPPING
+      assignment (some other slice already owns that rank);
+    - a launch roster that is not a whole number of slices leaves orphan
+      ranks no slice owns.
+
+    A joiner slice (slice_id >= roster slices) follows the elastic
+    convention: its ranks sit past the roster, the fabric admits the
+    whole slice mid-run."""
+    se = slice_env(n_visible_devices)
+    if se is None:
+        return None
+    slice_id, slice_size = se
+    rank, n_procs, _ = env_world()
+    if n_procs % slice_size:
+        raise ValueError(
+            f"launch roster of {n_procs} processes is not a whole number "
+            f"of {slice_size}-process slices — "
+            f"{n_procs % slice_size} rank(s) would belong to no slice")
+    rank_in_slice = rank - slice_id * slice_size
+    if not (0 <= rank_in_slice < slice_size):
+        owner = rank // slice_size
+        raise ValueError(
+            f"overlapping slice assignment: rank {rank} declares slice "
+            f"{slice_id} but the contiguous-block contract puts it in "
+            f"slice {owner} (slice k owns ranks [k*{slice_size}, "
+            f"(k+1)*{slice_size}))")
+    return SliceAssignment(slice_id=slice_id, slice_size=slice_size,
+                           rank_in_slice=rank_in_slice,
+                           n_slices=n_procs // slice_size)
+
+
+@dataclass(frozen=True)
 class Host:
     id: int
     ip: str

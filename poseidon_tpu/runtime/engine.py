@@ -794,8 +794,14 @@ class Engine:
                 self.train_pipelines, self._sample_sharding,
                 depth=self.device_prefetch)
         if self._async_cfg is not None and self._async_tier is None:
-            from .async_tier import AsyncSSPTier
-            self._async_tier = AsyncSSPTier(self.params, **self._async_cfg)
+            from .async_tier import AsyncSSPTier, FabricTier
+            # two-tier fabric mode ("slice": True, --slice): this process
+            # leads an SPMD slice and the DCN worker identity is the
+            # SLICE id — membership, gates and the data shard below all
+            # re-key to slice granularity (parallel/fabric.py)
+            cfg = dict(self._async_cfg)
+            tier_cls = FabricTier if cfg.pop("slice", False) else AsyncSSPTier
+            self._async_tier = tier_cls(self.params, **cfg)
             # every worker starts from the service anchor: rank 0's view on
             # a fresh run, the surviving anchor (all applied clocks) when
             # this process is a preemption restart rejoining mid-job, and

@@ -47,20 +47,45 @@ mode the elasticity chaos suite uses to kill a specific handshake (e.g.
 
 Runtime controls: :meth:`FaultProxy.sever_all` hard-drops every live
 connection at once (worker preemption / network partition mid-run);
-:meth:`FaultProxy.refuse_new` black-holes reconnect attempts (the
-partition persists) until lifted.
+:meth:`FaultProxy.sever_group` hard-drops every live connection belonging
+to a worker-id SET in one atomic event (a whole slice preempted at once —
+the two-tier fabric's failure unit); :meth:`FaultProxy.refuse_new`
+black-holes reconnect attempts (the partition persists) until lifted.
 """
 
 from __future__ import annotations
 
+import pickle
 import socket
 import struct
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 __all__ = ["FaultRule", "FaultProxy"]
+
+# worker-id sniffing gives up on any first frame bigger than this (a data
+# frame on a connection that skipped the hello — never the in-repo client)
+_SNIFF_CAP = 1 << 20
+
+
+@dataclass
+class _Pair:
+    """One live proxied connection. ``worker`` is discovered from the
+    client's first wire frame (the ``hello`` every AsyncSSPClient sends on
+    every socket) so group-targeted faults can address connections by the
+    worker they serve, not by accept order. Token-authenticated links put
+    a raw-byte HMAC preamble before the first frame, so — like the
+    per-frame delay billing — worker tagging assumes token-free links
+    (the chaos suites' configuration); an unparsable first frame just
+    leaves the pair untagged."""
+
+    client: socket.socket
+    upstream: socket.socket
+    worker: Optional[int] = None
+    sniff: bytes = b""
+    sniffed: bool = False
 
 
 @dataclass
@@ -112,7 +137,7 @@ class FaultProxy:
         self.upstream = upstream
         self._rules: List[FaultRule] = []
         self._lock = threading.Lock()
-        self._pairs: List[Tuple[socket.socket, socket.socket]] = []
+        self._pairs: List[_Pair] = []
         self.accepted = 0      # connections accepted (rule index space)
         self.dropped = 0       # connections refused (drop rule/refuse_new)
         self.bytes_c2s = 0
@@ -146,8 +171,29 @@ class FaultProxy:
         many pairs were cut."""
         with self._lock:
             pairs, self._pairs = self._pairs, []
-        for c, u in pairs:
-            for s in (c, u):
+        return self._cut(pairs)
+
+    def sever_group(self, worker_ids: Iterable[int]) -> int:
+        """Hard-close every live connection whose identified worker id is
+        in ``worker_ids``, as ONE atomic event: the victim set is chosen
+        under the lock, so a chaos test killing a whole slice (every
+        member's push + pull channel at once) cannot race per-link
+        ``sever_all`` calls against the victims' reconnect loops — the
+        deterministic analog of a slice preemption. Connections whose
+        hello frame has not yet crossed the proxy carry no worker tag and
+        are never matched (sever them by killing the slice AFTER its
+        first exchange, the way the fabric chaos suite does). Returns how
+        many pairs were cut."""
+        ids = frozenset(worker_ids)
+        with self._lock:
+            cut = [p for p in self._pairs if p.worker in ids]
+            self._pairs = [p for p in self._pairs if p.worker not in ids]
+        return self._cut(cut)
+
+    @staticmethod
+    def _cut(pairs: List[_Pair]) -> int:
+        for p in pairs:
+            for s in (p.client, p.upstream):
                 try:
                     s.shutdown(socket.SHUT_RDWR)
                 except OSError:
@@ -211,15 +257,45 @@ class FaultProxy:
             except OSError:
                 conn.close()
                 continue
+            pair = _Pair(conn, up)
             with self._lock:
-                self._pairs.append((conn, up))
+                self._pairs.append(pair)
             for src, dst, c2s in ((conn, up, True), (up, conn, False)):
                 threading.Thread(target=self._pump,
-                                 args=(src, dst, rule, c2s),
+                                 args=(src, dst, rule, c2s, pair),
                                  daemon=True).start()
 
+    def _sniff_worker(self, pair: _Pair, data: bytes) -> None:
+        """Walk the FIRST client->server wire frame (8-byte big-endian
+        length + pickled payload — the client's hello) and tag the pair
+        with its worker id. One-shot: success, an oversized frame, or an
+        unparsable payload all end sniffing for the connection."""
+        with self._lock:
+            if pair.sniffed:
+                return
+            pair.sniff += data
+            buf = pair.sniff
+            if len(buf) < 8:
+                return
+            (ln,) = struct.unpack("!Q", buf[:8])
+            if ln > _SNIFF_CAP:
+                pair.sniffed, pair.sniff = True, b""
+                return
+            if len(buf) < 8 + ln:
+                return
+            pair.sniffed = True
+            payload, pair.sniff = buf[8:8 + ln], b""
+            try:
+                msg = pickle.loads(payload)
+                if isinstance(msg, dict) and isinstance(
+                        msg.get("worker"), int):
+                    pair.worker = msg["worker"]
+            except Exception:  # noqa: BLE001 — not a hello; stay untagged
+                pass
+
     def _pump(self, src: socket.socket, dst: socket.socket,
-              rule: Optional[FaultRule], c2s: bool) -> None:
+              rule: Optional[FaultRule], c2s: bool,
+              pair: Optional[_Pair] = None) -> None:
         budget = None
         if rule is not None and rule.action in ("truncate", "sever") and c2s:
             budget = max(0, rule.after_bytes)
@@ -250,6 +326,8 @@ class FaultProxy:
                 data = src.recv(65536)
                 if not data:
                     break
+                if c2s and pair is not None and not pair.sniffed:
+                    self._sniff_worker(pair, data)
                 if delaying:
                     if rule.delay_per == "chunk":
                         time.sleep(rule.delay_s)
@@ -310,8 +388,9 @@ class FaultProxy:
                 except OSError:
                     pass
             with self._lock:
-                self._pairs = [(c, u) for c, u in self._pairs
-                               if c is not src and c is not dst]
+                self._pairs = [p for p in self._pairs
+                               if p.client is not src
+                               and p.client is not dst]
 
     def close(self) -> None:
         self._stop.set()

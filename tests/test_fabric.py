@@ -1,0 +1,620 @@
+"""Two-tier training fabric (ISSUE 16): SPMD slices as elastic SSP workers.
+
+The slice IS the worker: inside a slice the named dp/fsdp/tp mesh runs
+the step synchronously over the slice's own device block; between
+slices one leader process speaks the unmodified AsyncSSPClient protocol,
+so staleness bounds, exactly-once, admit/retire and eviction all apply
+at slice granularity with zero wire changes. These tests pin:
+
+- the POSEIDON_SLICE_ID / POSEIDON_SLICE_SIZE env contract (loud
+  all-or-nothing refusals; plain per-process mode unchanged when unset);
+- two-tier data sharding and the arena-delta exchange hooks;
+- leader failover: the successor re-derives the acked floor from the
+  service and resumes the ledger's oplog — exactly-once across leader
+  death, proven bitwise with power-of-two deltas through a severed
+  FaultProxy link;
+- the acceptance chaos run: 2 slices x dp2,fsdp2 real jitted sub-mesh
+  steps on the 8-device virtual CPU mesh, through kill-slice +
+  re-admit-slice, with loss continuity, zero gate deadlock, and the
+  final anchor BITWISE equal to a fixed-membership replay of the same
+  dispatched step sequence;
+- protocol-trace conformance of a failure-free slice-granularity run
+  (admit + retire of whole slices) against the model checker's rules.
+
+Every socket binds port 0 on loopback — no fixed ports, no flakes.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from poseidon_tpu.analysis import model_check as M
+from poseidon_tpu.config import (MeshConfig, fabric_config,
+                                 set_fabric_config)
+from poseidon_tpu.core.net import Net
+from poseidon_tpu.data.workload import Shard
+from poseidon_tpu.models import zoo
+from poseidon_tpu.parallel import CommConfig, init_train_state
+from poseidon_tpu.parallel.async_ssp import (AsyncSSPClient, ParamService,
+                                             _tree_copy, _tree_sub)
+from poseidon_tpu.parallel.fabric import (SliceWorker, arena_flat,
+                                          arena_tree, pack_arena_delta,
+                                          run_slice_worker,
+                                          slice_device_block, slice_submesh,
+                                          two_tier_shard,
+                                          unpack_arena_cache)
+from poseidon_tpu.parallel.spmd import ShardingPlan, build_spmd_train_step
+from poseidon_tpu.proto.messages import SolverParameter
+from poseidon_tpu.runtime.cluster import slice_env, slice_world
+from poseidon_tpu.runtime.faults import FaultProxy
+
+pytestmark = pytest.mark.fabric
+
+FAST = dict(heartbeat_s=0.1, reconnect_deadline_s=5.0,
+            backoff_base_s=0.01, backoff_cap_s=0.1)
+
+SLICE_VARS = ("POSEIDON_SLICE_ID", "POSEIDON_SLICE_SIZE",
+              "POSEIDON_PROC_ID", "POSEIDON_NUM_PROCS")
+
+
+def _clean_env(monkeypatch):
+    for v in SLICE_VARS:
+        monkeypatch.delenv(v, raising=False)
+
+
+def _zeros(shape=(2, 2)):
+    return {"fc": {"w": np.zeros(shape, np.float32)}}
+
+
+def _delta(v, shape=(2, 2)):
+    return {"fc": {"w": np.full(shape, v, np.float32)}}
+
+
+def _wait_for(pred, timeout_s=15.0, what="condition"):
+    deadline = time.time() + timeout_s
+    while not pred():
+        if time.time() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.01)
+
+
+def _tree_equal(a, b, what=""):
+    assert set(a) == set(b)
+    for l in a:
+        for k in a[l]:
+            np.testing.assert_array_equal(
+                np.asarray(a[l][k]), np.asarray(b[l][k]),
+                err_msg=f"{what} {l}/{k}")
+
+
+# --------------------------------------------------------------------------- #
+# slice env contract (runtime/cluster.py)
+# --------------------------------------------------------------------------- #
+
+def test_slice_env_unset_is_plain_mode(monkeypatch):
+    """Neither variable set -> None: the per-process path stays
+    byte-for-byte unchanged (the fabric is strictly opt-in)."""
+    _clean_env(monkeypatch)
+    assert slice_env() is None
+    assert slice_world() is None
+
+
+def test_slice_env_half_set_is_refused(monkeypatch):
+    _clean_env(monkeypatch)
+    monkeypatch.setenv("POSEIDON_SLICE_ID", "0")
+    with pytest.raises(ValueError, match="all-or-nothing"):
+        slice_env()
+    _clean_env(monkeypatch)
+    monkeypatch.setenv("POSEIDON_SLICE_SIZE", "2")
+    with pytest.raises(ValueError, match="all-or-nothing"):
+        slice_env()
+
+
+def test_slice_env_impossible_values_are_refused(monkeypatch):
+    _clean_env(monkeypatch)
+    monkeypatch.setenv("POSEIDON_SLICE_ID", "-1")
+    monkeypatch.setenv("POSEIDON_SLICE_SIZE", "2")
+    with pytest.raises(ValueError, match="must be >= 0"):
+        slice_env()
+    monkeypatch.setenv("POSEIDON_SLICE_ID", "0")
+    monkeypatch.setenv("POSEIDON_SLICE_SIZE", "0")
+    with pytest.raises(ValueError, match="must be >= 1"):
+        slice_env()
+    monkeypatch.setenv("POSEIDON_SLICE_SIZE", "4")
+    with pytest.raises(ValueError, match="cannot share a device"):
+        slice_env(n_visible_devices=2)
+
+
+def test_slice_world_contiguous_block_contract(monkeypatch):
+    """slice k owns ranks [k*size, (k+1)*size); rank 0 of the block is
+    the leader; a slice id past the roster is a joiner."""
+    _clean_env(monkeypatch)
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "4")
+    monkeypatch.setenv("POSEIDON_SLICE_SIZE", "2")
+    monkeypatch.setenv("POSEIDON_SLICE_ID", "1")
+    monkeypatch.setenv("POSEIDON_PROC_ID", "2")
+    sw = slice_world()
+    assert (sw.slice_id, sw.rank_in_slice, sw.n_slices) == (1, 0, 2)
+    assert sw.is_leader and not sw.is_joiner_slice
+    monkeypatch.setenv("POSEIDON_PROC_ID", "3")
+    sw = slice_world()
+    assert sw.rank_in_slice == 1 and not sw.is_leader
+    # elastic joiner slice: ranks past the roster, whole slice admitted
+    monkeypatch.setenv("POSEIDON_SLICE_ID", "2")
+    monkeypatch.setenv("POSEIDON_PROC_ID", "4")
+    sw = slice_world()
+    assert sw.is_joiner_slice and sw.is_leader
+
+
+def test_slice_world_refuses_overlap_and_orphan_ranks(monkeypatch):
+    _clean_env(monkeypatch)
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "4")
+    monkeypatch.setenv("POSEIDON_SLICE_SIZE", "2")
+    monkeypatch.setenv("POSEIDON_SLICE_ID", "1")
+    monkeypatch.setenv("POSEIDON_PROC_ID", "0")   # rank 0 is slice 0's
+    with pytest.raises(ValueError, match="overlapping slice assignment"):
+        slice_world()
+    monkeypatch.setenv("POSEIDON_PROC_ID", "2")
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "5")  # 5 % 2 != 0
+    with pytest.raises(ValueError, match="whole number"):
+        slice_world()
+
+
+# --------------------------------------------------------------------------- #
+# two-tier sharding + arena exchange hooks (parallel/fabric.py units)
+# --------------------------------------------------------------------------- #
+
+def test_two_tier_shard_composes_outer_and_inner_cuts():
+    """outer cut by live slice ids, inner by live member ranks: the
+    composed shards are disjoint and cover record space; a slice retire
+    re-cuts the outer tier, a member loss only the inner tier."""
+    # 2 slices x 2 members -> 4 disjoint shards of count 4
+    got = {two_tier_shard([0, 1], s, [0, 1], r)
+           for s in (0, 1) for r in (0, 1)}
+    assert got == {Shard(i, 4) for i in range(4)}
+    # slice 1 retired: slice 0's members re-key to count 2
+    assert two_tier_shard([0], 0, [0, 1], 1) == Shard(1, 2)
+    # slice 0 lost member 0: inner re-cut only (outer count unchanged)
+    assert two_tier_shard([0, 1], 0, [1], 1) == Shard(0, 2)
+    # non-member lookups refuse loudly (member_shard's contract)
+    with pytest.raises(ValueError):
+        two_tier_shard([0, 1], 0, [0, 1], 7)
+
+
+def test_slice_device_block_is_contiguous_and_bounded():
+    devs = list(range(8))
+    assert slice_device_block(devs, 0, 4) == [0, 1, 2, 3]
+    assert slice_device_block(devs, 1, 4) == [4, 5, 6, 7]
+    with pytest.raises(ValueError, match="contiguous"):
+        slice_device_block(devs, 2, 4)
+
+
+class _TinyLayout:
+    """Duck-typed stand-in for core/arena.ArenaLayout: the fabric hooks
+    only rely on the pack/unpack pair being exact inverses."""
+
+    def pack(self, tree):
+        return np.concatenate([tree["a"]["w"].ravel(),
+                               tree["b"]["w"].ravel()]).astype(np.float32)
+
+    def unpack(self, flat):
+        flat = np.asarray(flat, np.float32)
+        return {"a": {"w": flat[:4].reshape(2, 2).copy()},
+                "b": {"w": flat[4:6].copy()}}
+
+
+def test_arena_delta_hooks_roundtrip_bitwise():
+    """pack_arena_delta -> wire -> unpack_arena_cache is exact: the DCN
+    tier pushes ONE flat leaf (global TOPK ranking over the whole slice
+    update) and the per-leaf tree survives the round trip bitwise."""
+    layout = _TinyLayout()
+    rng = np.random.RandomState(3)
+    params = {"a": {"w": rng.randn(2, 2).astype(np.float32)},
+              "b": {"w": rng.randn(2).astype(np.float32)}}
+    prev = np.zeros(6, np.float32)
+    delta, flat = pack_arena_delta(layout, params, prev)
+    assert set(delta) == {"arena"} and arena_flat(delta).shape == (6,)
+    np.testing.assert_array_equal(arena_flat(delta), flat - prev)
+    np.testing.assert_array_equal(arena_flat(arena_tree(flat)), flat)
+    _tree_equal(unpack_arena_cache(layout, arena_tree(flat)), params,
+                "arena roundtrip")
+    # incremental: prev + delta reconstructs the new flat view bitwise
+    delta2, flat2 = pack_arena_delta(layout, params, flat)
+    np.testing.assert_array_equal(arena_flat(delta2), np.zeros(6, np.float32))
+    np.testing.assert_array_equal(flat2, flat)
+
+
+# --------------------------------------------------------------------------- #
+# resume_oplog: the failover primitive (parallel/async_ssp.py)
+# --------------------------------------------------------------------------- #
+
+def test_resume_oplog_rederives_floor_and_replays_only_above_it():
+    """The successor's acked floor comes from the SERVICE's applied
+    table, not the dead leader's memory: ledger entries at or below it
+    are never re-sent, entries above replay with their original seqs,
+    and the post-resume push stream continues past the high-water."""
+    svc = ParamService(_zeros(), n_workers=1, liveness_timeout_s=0.0)
+    addr = ("127.0.0.1", svc.port)
+    d0, d1 = _delta(1.0), _delta(2.0)
+    a = AsyncSSPClient(0, addr, 1, n_workers=1, **FAST)
+    a.push(_tree_copy(d0))
+    _wait_for(lambda: svc.clocks[0] >= 0, what="clock 0 applied")
+    a.abandon()                      # leader death: no flush, no bye
+    # the mirrored ledger: clock 1's payload never made it out; clock 0
+    # rides the ledger too (a stale-but-superset mirror must be safe)
+    pending = [(0, _tree_copy(d0), True), (1, _tree_copy(d1), True)]
+    b = AsyncSSPClient(0, addr, 1, n_workers=1, **FAST)
+    try:
+        floor = b.resume_oplog(1, pending, _tree_copy(d1))
+        assert floor == 0 and b.clock == 1
+        np.testing.assert_array_equal(b._residual["fc"]["w"],
+                                      d1["fc"]["w"])
+        _wait_for(lambda: svc.clocks[0] >= 1, what="replayed clock 1")
+        np.testing.assert_array_equal(
+            svc.anchor["fc"]["w"], np.full((2, 2), 3.0, np.float32))
+        # seq stream resumes PAST the high-water: the next flush is not
+        # swallowed by dedup and not double-applied, and the restored
+        # residual rides it out exactly once (4 + parked 2 = 6 on top of
+        # the 3 already anchored) — no parked bytes die with the leader
+        assert b.push(_delta(4.0)) == 2
+        _wait_for(lambda: svc.clocks[0] >= 2, what="post-resume push")
+        np.testing.assert_array_equal(
+            svc.anchor["fc"]["w"], np.full((2, 2), 9.0, np.float32))
+        b.mark_done()
+    finally:
+        b.close()
+        svc.close()
+
+
+# --------------------------------------------------------------------------- #
+# slice membership events via the run_slice_worker driver
+# --------------------------------------------------------------------------- #
+
+def test_slice_shrink_recuts_inner_shard_and_keeps_training():
+    """A non-leader member loss shrinks the slice: the inner data cut
+    re-keys over the survivors and the DCN stream never blinks."""
+    svc = ParamService(_zeros(), n_workers=1, liveness_timeout_s=0.0)
+    w = SliceWorker(0, [0, 1, 2], ("127.0.0.1", svc.port), 1,
+                    n_slices=1, client_opts=FAST)
+    try:
+        assert w.data_shard([0], rank=2) == Shard(2, 3)
+
+        def step(cache, i):
+            return ({l: {p: v + 1.0 for p, v in ps.items()}
+                     for l, ps in cache.items()}, 0.0)
+
+        out = run_slice_worker(w, _zeros(), step, n_clocks=3,
+                               fail_at={1: [1]})
+        assert out["events"] == [(1, "shrunk:1")]
+        assert out["failovers"] == 0 and not out["retired"]
+        assert w.data_shard([0], rank=2) == Shard(1, 2)
+        _wait_for(lambda: svc.clocks[0] >= 2, what="3 clocks applied")
+        np.testing.assert_array_equal(
+            svc.anchor["fc"]["w"], np.full((2, 2), 3.0, np.float32))
+    finally:
+        w.close()
+        svc.close()
+
+
+def test_slice_below_min_members_retires_cleanly():
+    """Falling below FabricConfig.min_members retires the slice's DCN
+    slot (flush + retire RPC) so survivors' gates stop counting it; a
+    leader death on the way down still fails over first, so the retire
+    flush carries the full oplog."""
+    old_min = fabric_config().min_members
+    set_fabric_config(min_members=2)
+    svc = ParamService(_zeros(), n_workers=1, liveness_timeout_s=0.0)
+    w = None
+    try:
+        w = SliceWorker(0, [0, 1], ("127.0.0.1", svc.port), 1,
+                        n_slices=1, client_opts=FAST)
+
+        def step(cache, i):
+            return ({l: {p: v + 1.0 for p, v in ps.items()}
+                     for l, ps in cache.items()}, 0.0)
+
+        out = run_slice_worker(w, _zeros(), step, n_clocks=4,
+                               fail_at={2: [0]})   # the LEADER dies
+        assert out["events"] == [(2, "retired:0")]
+        assert out["retired"] and out["failovers"] == 1
+        assert 0 in svc.retired
+        # clocks 0 and 1 flushed before the event; nothing after
+        _wait_for(lambda: svc.clocks[0] >= 1, what="pre-retire clocks")
+        np.testing.assert_array_equal(
+            svc.anchor["fc"]["w"], np.full((2, 2), 2.0, np.float32))
+    finally:
+        set_fabric_config(min_members=old_min)
+        if w is not None:
+            w.close()
+        svc.close()
+
+
+# --------------------------------------------------------------------------- #
+# leader failover: exactly-once across leader death (the tentpole pin)
+# --------------------------------------------------------------------------- #
+
+def test_leader_failover_exactly_once_through_severed_links():
+    """The leader's links are cut mid-window (runtime/faults.sever_group
+    — the targeted half of a partition) and the slice fails over: the
+    successor re-derives the acked floor and resumes the ledger. Deltas
+    are DISTINCT POWERS OF TWO, so the final anchor is bitwise the exact
+    sum iff every (slice, clock) delta applied exactly once — a lost
+    replay or a double apply each perturb at least one mantissa bit."""
+    N = 4
+    svc = ParamService(_zeros((1,)), n_workers=2, record_events=True,
+                       liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    slices = [SliceWorker(0, [0, 1], proxy.addr, 1, n_slices=2,
+                          client_opts=FAST),
+              SliceWorker(1, [0, 1], proxy.addr, 1, n_slices=2,
+                          client_opts=FAST)]
+    try:
+        for s in slices:
+            s.join()
+        for clock in range(N):
+            if clock == 2:
+                # kill slice 0's leader between windows: its clock-1 ack
+                # may or may not have landed — both paths must be
+                # exactly-once (lost-ack replay dedups by seq)
+                assert proxy.sever_group({0}) >= 1
+                assert slices[0].fail_member(0) == "failover"
+                assert slices[0].leader == 1
+                assert slices[0].failovers == 1
+            for sid, s in enumerate(slices):
+                s.gate(clock, timeout_s=60)
+                s.push(_delta(2.0 ** (sid * 16 + clock), shape=(1,)))
+        _wait_for(lambda: svc.clocks == {0: N - 1, 1: N - 1},
+                  what="all slice clocks applied")
+        expected = np.float32(sum(2.0 ** (sid * 16 + c)
+                                  for sid in (0, 1) for c in range(N)))
+        got = svc.anchor["fc"]["w"][0]
+        assert got == expected, (
+            f"anchor {got!r} != {expected!r}: a delta was lost or "
+            f"double-applied across the failover")
+        # the event log agrees: every (worker, clock) applied once
+        applied = [(e[1], e[2]) for e in svc.events
+                   if e[0] == "push" and not e[4]]
+        assert len(applied) == len(set(applied))
+        assert slices[0].ledger.mirrors >= N + 1   # re-mirrored at failover
+    finally:
+        for s in slices:
+            s.close()
+        proxy.close()
+        svc.close()
+
+
+# --------------------------------------------------------------------------- #
+# protocol conformance at slice granularity (admit + retire whole slices)
+# --------------------------------------------------------------------------- #
+
+def test_slice_granularity_run_conforms_to_protocol_model():
+    """A failure-free 3-slice run — launch roster of 2, one retires
+    mid-run, a joiner slice is admitted at the rendezvous clock — replays
+    cleanly through the model checker's service-state rules: the slice id
+    IS a worker id, so every pinned protocol property carries over by
+    config, not by new code."""
+    svc = ParamService(_zeros(), n_workers=2, record_events=True)
+    addr = ("127.0.0.1", svc.port)
+    w0 = SliceWorker(0, [0, 1], addr, 0, n_slices=2, client_opts=FAST)
+    w1 = SliceWorker(1, [0, 1], addr, 0, n_slices=2, client_opts=FAST)
+    w2 = None
+    try:
+        for clock in range(2):
+            for s in (w0, w1):
+                s.gate(clock, timeout_s=60)
+                s.push(_delta(1.0))
+        w1.retire()
+        _wait_for(lambda: svc.clocks[0] >= 1, what="roster clocks applied")
+        w2 = SliceWorker(2, [0], addr, 0, n_slices=2, client_opts=FAST)
+        cache, clocks = w2.join()          # whole-slice admit mid-run
+        assert w2.client.clock >= 1        # anchored at rendezvous clock
+        _tree_equal(cache, svc.anchor, "join anchor")
+        for clock in range(2, 4):
+            for s in (w0, w2):
+                s.gate(clock, timeout_s=60)
+                s.push(_delta(1.0))
+        w0.mark_done()
+        w2.mark_done()
+        _wait_for(lambda: svc.clocks[0] >= 3, what="final clocks")
+        counts = M.conform_service_events(list(svc.events), staleness=0,
+                                          n_workers=2)
+        assert counts["push"] >= 7         # 4 + 2 + >=1 (w2's windows)
+        assert counts["retire"] == 1
+        assert counts["admit"] >= 1        # the joiner slice's rendezvous
+    finally:
+        for s in (w0, w1, w2):
+            if s is not None:
+                s.close()
+        svc.close()
+
+
+# --------------------------------------------------------------------------- #
+# the acceptance chaos run: 2 slices x dp2,fsdp2, kill + re-admit, bitwise
+# --------------------------------------------------------------------------- #
+
+SP = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9,
+                     weight_decay=0.0005)
+BATCH = 16
+N_CLOCKS = 5
+KILL, REJOIN = 2, 3
+STALE = 1
+
+
+def _np_tree(tree):
+    return {l: {p: np.asarray(v) for p, v in ps.items()}
+            for l, ps in tree.items()}
+
+
+def _fabric_batch(slice_id, clock):
+    rng = np.random.RandomState(123 + 17 * slice_id + clock)
+    return {"data": rng.randn(BATCH, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, size=(BATCH,))}
+
+
+def test_two_slice_chaos_bitwise_replay():
+    """The acceptance run. Two SliceWorkers, each running REAL jitted
+    SPMD steps on its own dp2,fsdp2 sub-mesh (contiguous 4-device blocks
+    of the 8-device virtual CPU mesh). Slice 1 is killed at a clock
+    boundary (every member lost, sockets die raw), the survivor's gates
+    keep passing (zero deadlock), and a fresh slice re-admits under the
+    same id, warm-starting from the already-compiled step and anchoring
+    at the service's rendezvous clock. The final anchor is BITWISE equal
+    to a fixed-membership replay that dispatches the same step sequence
+    (same batches, same keys, same apply order) with slice 1 merely
+    pausing over the dead window — membership chaos changed WHEN updates
+    flowed, never WHAT they computed."""
+    cfg = MeshConfig.parse("dp2,fsdp2")
+    net = Net(zoo.lenet(with_accuracy=False), phase="TRAIN",
+              source_shapes=zoo.lenet_shapes(BATCH // 4))
+    comm = CommConfig()
+    plan = ShardingPlan.build(net, cfg, comm)
+    params0 = net.init(jax.random.PRNGKey(0))
+    init_np = _np_tree(params0)
+    # one compiled step per device block; the re-admitted slice 1 reuses
+    # the SAME executable — the compile-cache warm-start in test form
+    steps = [build_spmd_train_step(
+                 net, SP, slice_submesh(cfg, sid), plan, comm,
+                 donate=False)
+             for sid in (0, 1)]
+    state0 = init_train_state(params0, comm, plan.n_dp)
+
+    def drive(chaos):
+        svc = ParamService(_np_tree(init_np), n_workers=2,
+                           record_events=True, liveness_timeout_s=0.0)
+        addr = ("127.0.0.1", svc.port)
+        sw = [SliceWorker(0, [0, 1], addr, STALE, n_slices=2,
+                          client_opts=FAST),
+              SliceWorker(1, [0, 1], addr, STALE, n_slices=2,
+                          client_opts=FAST)]
+        caches = [c for c, _ in (sw[0].join(), sw[1].join())]
+        states = [state0, state0]
+        losses = {0: [], 1: []}
+
+        def dispatch(sid, clock):
+            w = sw[sid]
+            w.gate(clock, timeout_s=60)
+            prev = _tree_copy(caches[sid])
+            p, s, m = steps[sid].step(caches[sid], states[sid],
+                                      _fabric_batch(sid, clock),
+                                      jax.random.fold_in(
+                                          jax.random.PRNGKey(42),
+                                          100 * sid + clock))
+            states[sid] = s
+            caches[sid] = _np_tree(p)
+            losses[sid].append((clock, float(m["loss"])))
+            pushed = w.push(_tree_sub(caches[sid], prev))
+            # pin the apply order: the next dispatch must see this
+            # update in the anchor, in both arms, for bitwise replay
+            _wait_for(lambda: w.client.poll_view().get(sid, -1) >= pushed,
+                      what=f"slice {sid} clock {pushed} applied")
+            caches[sid], _ = w.refresh()
+
+        try:
+            for clock in range(N_CLOCKS):
+                if chaos and clock == KILL:
+                    # whole-slice death: shrink, then the last member
+                    assert sw[1].fail_member(1) == "shrunk"
+                    assert sw[1].fail_member(0) == "dead"
+                    sw[1].client.abandon()
+                    _wait_for(lambda: 1 in svc.failed_workers,
+                              what="slice 1 evicted")
+                if clock == REJOIN:
+                    if chaos:
+                        sw[1] = SliceWorker(1, [10, 11], addr, STALE,
+                                            n_slices=2, client_opts=FAST)
+                        caches[1], _ = sw[1].join()
+                        # the rendezvous rule for a re-admitted id:
+                        # resume past its OWN historical high-water
+                        # (its last flushed clock before death), never
+                        # behind it — the clock stream continues as if
+                        # the dead window were a pause
+                        assert sw[1].client.clock == KILL - 1, \
+                            "rejoined slice must anchor at the " \
+                            "rendezvous clock"
+                    else:
+                        caches[1], _ = sw[1].refresh()
+                    states[1] = state0   # warm start = anchor + fresh state
+                dispatch(0, clock)
+                if not (KILL <= clock < REJOIN):
+                    dispatch(1, clock)
+            sw[0].mark_done()
+            sw[1].mark_done()
+            _wait_for(lambda: svc.clocks[0] >= N_CLOCKS - 1,
+                      what="final survivor clock")
+            anchor = _tree_copy(svc.anchor)
+            applied = [(e[1], e[2]) for e in svc.events
+                       if e[0] == "push" and not e[4]]
+            return {"anchor": anchor, "losses": losses,
+                    "applied": applied, "rejoins": svc.rejoins,
+                    "blocked_s": sw[0].client.blocked_s}
+        finally:
+            for s in sw:
+                s.close()
+            svc.close()
+
+    chaos = drive(chaos=True)
+    replay = drive(chaos=False)
+
+    # exactly-once through the chaos: every (slice, clock) applied once
+    assert len(chaos["applied"]) == len(set(chaos["applied"]))
+    assert chaos["rejoins"] >= 1
+    # the acceptance pin: membership chaos is bitwise-invisible in the
+    # final parameters
+    _tree_equal(chaos["anchor"], replay["anchor"], "chaos vs replay")
+    # loss continuity, in the strongest sense: the chaos trajectory is
+    # finite throughout and EQUALS the fixed-membership replay's loss
+    # sequence bitwise, per slice per clock — the kill/re-admit left no
+    # trace in what either slice computed, only in when it flowed
+    assert all(np.isfinite(v) for ls in chaos["losses"].values()
+               for _, v in ls)
+    assert chaos["losses"] == replay["losses"]
+    # and the rejoined slice really did dispatch after the dead window
+    assert [c for c, _ in chaos["losses"][1]] == \
+        [c for c in range(N_CLOCKS) if not (KILL <= c < REJOIN)]
+
+
+# --------------------------------------------------------------------------- #
+# FabricTier: the engine hook (train --async_ssp --slice)
+# --------------------------------------------------------------------------- #
+
+def test_fabric_tier_leader_speaks_as_slice_id(monkeypatch):
+    """Under the slice env the tier's DCN identity is the SLICE id and
+    the roster is counted in whole slices; the leader owns the ledger."""
+    from poseidon_tpu.runtime.async_tier import FabricTier
+    _clean_env(monkeypatch)
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "4")
+    monkeypatch.setenv("POSEIDON_SLICE_SIZE", "2")
+    monkeypatch.setenv("POSEIDON_SLICE_ID", "0")
+    monkeypatch.setenv("POSEIDON_PROC_ID", "0")
+    tier = FabricTier(_zeros(), staleness=1, service_port=0,
+                      liveness_timeout_s=0.0)
+    try:
+        assert (tier.rank, tier.n_procs) == (0, 2)   # slice 0 of 2 slices
+        assert tier.slice_assignment.is_leader
+        assert tier.service is not None and tier.service.n_workers == 2
+        # the flush hook mirrors the oplog into the slice ledger
+        tier.client.push(_delta(1.0))
+        tier._mirror()
+        assert tier.ledger.mirrors == 1
+        clock, pending, _ = tier.ledger.snapshot()
+        assert clock == 0
+        tier.client.mark_done()
+    finally:
+        tier.client.close()
+        tier.service.close()
+
+
+def test_fabric_tier_refuses_non_leader_and_missing_env(monkeypatch):
+    from poseidon_tpu.runtime.async_tier import FabricTier
+    _clean_env(monkeypatch)
+    with pytest.raises(ValueError, match="requires the slice env"):
+        FabricTier(_zeros(), staleness=1)
+    monkeypatch.setenv("POSEIDON_NUM_PROCS", "4")
+    monkeypatch.setenv("POSEIDON_SLICE_SIZE", "2")
+    monkeypatch.setenv("POSEIDON_SLICE_ID", "0")
+    monkeypatch.setenv("POSEIDON_PROC_ID", "1")   # rank-in-slice 1
+    with pytest.raises(ValueError, match="not the leader"):
+        FabricTier(_zeros(), staleness=1)

@@ -909,3 +909,108 @@ def test_delay_per_frame_models_small_and_large_frames_alike():
     # per-chunk billing would put big ~15 x 0.25 s ahead of small; per-
     # frame keeps them within scheduling noise (loose CI-safe bound)
     assert abs(big - small) < 1.2, (small, big)
+
+
+# --------------------------------------------------------------------------- #
+# group severing: kill a whole slice in one atomic event (ISSUE 16)
+# --------------------------------------------------------------------------- #
+
+def test_sever_group_cuts_only_the_targeted_workers():
+    """sever_group must cut EVERY connection of the targeted worker-id
+    set (both the push and pull channels) and NONE of the others — the
+    deterministic 'preempt one slice' event the fabric chaos suite is
+    built on."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=3, liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    clients = {}
+    try:
+        for w in range(3):
+            clients[w] = AsyncSSPClient(w, proxy.addr, staleness=2,
+                                        n_workers=3, **FAST)
+            clients[w].push(_one())
+        # every hello has crossed the proxy: all 6 pairs carry a tag
+        _wait_for(lambda: sum(1 for p in proxy._pairs
+                              if p.worker is not None) >= 6,
+                  what="worker-tagged pairs")
+        cut = proxy.sever_group({0, 1})
+        assert cut == 4, cut           # 2 workers x (push + pull)
+        # the survivor's channels still work end to end: a fresh push
+        # on worker 2 is acked without any reconnect
+        before = clients[2].reconnects
+        clients[2].push(_one())
+        _wait_for(lambda: clients[2]._acked_clock == clients[2].clock,
+                  what="survivor push ack")
+        assert clients[2].reconnects == before
+        # the severed workers' clients REDIAL (new proxied pairs) and
+        # replay their un-acked stream exactly once
+        for w in (0, 1):
+            clients[w].push(_one())
+            _wait_for(lambda w=w: clients[w]._acked_clock
+                      == clients[w].clock, what=f"worker {w} replay ack")
+        assert dict(svc.clocks) == {0: 1, 1: 1, 2: 1}
+    finally:
+        for c in clients.values():
+            c.close()
+        proxy.close()
+        svc.close()
+
+
+def test_sever_group_is_atomic_and_ignores_unknown_ids():
+    """The victim set is chosen under one lock acquisition: ids with no
+    live tagged pairs cut nothing, an empty set cuts nothing, and the
+    pair list shrinks by exactly the cut count (no survivor is ever
+    collateral damage)."""
+    params = _zeros_params()
+    svc = ParamService(params, n_workers=2, liveness_timeout_s=0.0)
+    proxy = FaultProxy(("127.0.0.1", svc.port))
+    try:
+        cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=2,
+                             **FAST)
+        try:
+            cli.push(_one())
+            _wait_for(lambda: sum(1 for p in proxy._pairs
+                                  if p.worker == 0) >= 2,
+                      what="tagged pairs for worker 0")
+            assert proxy.sever_group(set()) == 0
+            assert proxy.sever_group({7, 8, 9}) == 0
+            with proxy._lock:
+                n_before = len(proxy._pairs)
+            assert proxy.sever_group({0}) == 2
+            with proxy._lock:
+                assert len(proxy._pairs) == n_before - 2
+        finally:
+            cli.close()
+    finally:
+        proxy.close()
+        svc.close()
+
+
+def test_sever_group_untagged_connections_never_match():
+    """A connection whose first frame is not a worker hello stays
+    untagged and must survive every sever_group call (None is never a
+    member of the id set) — severing by slice only ever kills identified
+    members."""
+    srv = _echo_server()
+    try:
+        proxy = FaultProxy(srv.getsockname())
+        try:
+            c = socket.create_connection(proxy.addr)
+            try:
+                # a raw frame whose payload is not a pickled hello dict
+                c.sendall(struct.pack("!Q", 5) + b"xxxxx")
+                _wait_for(lambda: len(proxy._pairs) == 1,
+                          what="pair registered")
+                _wait_for(lambda: proxy._pairs[0].sniffed,
+                          what="sniff to give up")
+                assert proxy._pairs[0].worker is None
+                assert proxy.sever_group({0, 1, 2}) == 0
+                # the link still works after the no-op sever
+                got = c.recv(65536)
+                assert got  # echo came back
+            finally:
+                c.close()
+        finally:
+            proxy.close()
+    finally:
+        srv.close()

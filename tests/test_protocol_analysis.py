@@ -388,10 +388,13 @@ def test_model_check_tiny_pinned():
 
 
 def test_model_check_smoke_acceptance_set():
-    """The ISSUE 15 acceptance: all 2-worker x staleness {0,1,2} configs
-    with one admit AND one retire event (plus a crash/rejoin and a
-    lost-ack replay in the schedule) verify clean, with explored-state
-    counts reported, well under the 60 s CI budget."""
+    """The ISSUE 15 acceptance (all 2-worker x staleness {0,1,2} configs
+    with one admit AND one retire event plus a crash/rejoin and a
+    lost-ack replay in the schedule) extended by the ISSUE 16 fabric
+    configs (a worker is a SLICE: slice-granular admit/retire, and
+    leader failover crossed with lost acks and partial pushes) — all
+    verify clean, with explored-state counts reported, well under the
+    60 s CI budget."""
     t0 = time.time()
     results, caught = M.run_level("smoke")
     wall = time.time() - t0
@@ -399,15 +402,25 @@ def test_model_check_smoke_acceptance_set():
     by_name = {r.config.name: r for r in results}
     assert set(by_name) == {"2w-s0-admit-retire-crash",
                             "2w-s1-admit-retire-crash",
-                            "2w-s2-admit-retire-crash"}
+                            "2w-s2-admit-retire-crash",
+                            "2slice-s1-admit-retire",
+                            "2slice-s1-leader-failover"}
     for r in results:
         assert r.ok, (r.config.name, r.violations)
-        assert r.config.admit_id is not None
-        assert r.config.retire_worker is not None
-    # exact state-space pins (regression detectors for silent pruning)
+    for name in ("2w-s0-admit-retire-crash", "2w-s1-admit-retire-crash",
+                 "2w-s2-admit-retire-crash", "2slice-s1-admit-retire"):
+        assert by_name[name].config.admit_id is not None
+        assert by_name[name].config.retire_worker is not None
+    # exact state-space pins (regression detectors for silent pruning).
+    # The pre-fabric counts are UNCHANGED: the new worker field (lost)
+    # and budget element (failovers_left) are constant when
+    # max_failovers == 0, so the old configs' reachable spaces are
+    # isomorphic to their PR 15 shapes.
     assert by_name["2w-s0-admit-retire-crash"].states == 1354
     assert by_name["2w-s1-admit-retire-crash"].states == 7596
     assert by_name["2w-s2-admit-retire-crash"].states == 22622
+    assert by_name["2slice-s1-admit-retire"].states == 1524
+    assert by_name["2slice-s1-leader-failover"].states == 1336
     assert all(caught.values()), caught
 
 
@@ -444,6 +457,52 @@ def test_seeded_retire_stays_member_deadlocks():
     res = M.explore(cfg, mutation="retire_stays_member")
     assert not res.ok
     assert res.violations[0].invariant == "deadlock"
+
+
+def test_seeded_failover_loses_residual_is_caught():
+    """ISSUE 16 acceptance mutation #1: a failover successor that drops
+    the slice's parked residual must trip the completeness monitor at
+    the next full flush — the bytes a partial push deferred are SLICE
+    state, and exactly what the ledger replication exists to carry."""
+    cfg = M.Config(name="fo-resid", n_workers=2, staleness=1, n_clocks=3,
+                   managed=True, max_failovers=1)
+    res = M.explore(cfg, mutation="leader_failover_loses_residual")
+    assert not res.ok
+    v = res.violations[0]
+    assert v.invariant == "failover_completeness"
+    # the trace must really be partial-push -> failover -> full flush
+    assert any("push_partial" in step for step in v.trace)
+    assert any("failover" in step for step in v.trace)
+    # the correct protocol under the same schedule verifies clean
+    assert M.explore(cfg).ok
+
+
+def test_seeded_double_apply_across_leaders_is_caught():
+    """ISSUE 16 acceptance mutation #2: a successor that restarts its
+    seq stream instead of re-deriving the high-water mark re-applies the
+    ledgered entry whose ack died with the old leader — the
+    exactly-once monitor must flag it."""
+    cfg = M.Config(name="fo-dup", n_workers=2, staleness=1, n_clocks=3,
+                   managed=True, max_lost_acks=1, max_failovers=1)
+    res = M.explore(cfg, mutation="double_apply_across_leaders")
+    assert not res.ok
+    v = res.violations[0]
+    assert v.invariant == "exactly_once"
+    assert any("push_full_acklost" in step for step in v.trace)
+    assert v.trace[-1].startswith("failover")
+    assert M.explore(cfg).ok
+
+
+def test_failover_family_off_by_default_preserves_state_space():
+    """max_failovers=0 must leave the pre-fabric model bit-identical:
+    same states, same transitions (the pins above depend on it)."""
+    res = M.explore(M.tiny_config())
+    assert (res.states, res.transitions) == (121, 230)
+    # and enabling the family strictly grows the explored space
+    grown = M.explore(M.Config(name="tiny-fo", n_workers=2, staleness=1,
+                               n_clocks=3, managed=True, max_failovers=1))
+    assert grown.ok
+    assert grown.states > res.states
 
 
 def test_unknown_mutation_rejected():
