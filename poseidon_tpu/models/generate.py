@@ -43,18 +43,26 @@ def _attend_cached(q, ck, cv, q_pos0):
 
 
 def _block_cached(cfg: TransformerConfig, x, blk, ck, cv, pos0, *,
-                  moe_cfg=None, prefill=False):
+                  moe_cfg=None, prefill=False, tp_layout=False):
     """One decoder block writing new K/V at ``pos0`` and attending against
     the (updated) cache. Returns (x_out, ck, cv). ``prefill`` (static)
     marks the first call, where the cache holds nothing but this call's own
     keys — attention is then ordinary causal self-attention over the
     prompt, which routes through the flash kernel (O(S) HBM) instead of
-    materializing the S x T score matrix against the padded cache."""
+    materializing the S x T score matrix against the padded cache.
+    ``tp_layout`` (static) marks head-major wqkv rows
+    (models/transformer.py ``to_tp_layout``) — same flag as
+    :func:`_block_paged`."""
     b, s, _ = x.shape
     dh = cfg.d_model // cfg.n_heads
     h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
-    qkv = _dense(h, blk["wqkv"]).reshape(b, s, 3, cfg.n_heads, dh)
-    q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))  # (B,H,S,Dh)
+    qkv = _dense(h, blk["wqkv"])
+    if tp_layout:
+        qkv = qkv.reshape(b, s, cfg.n_heads, 3, dh)
+        q, k, v = (qkv[:, :, :, j].swapaxes(1, 2) for j in range(3))
+    else:
+        qkv = qkv.reshape(b, s, 3, cfg.n_heads, dh)
+        q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))  # (B,H,S,Dh)
     ck = lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos0, axis=2)
     cv = lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos0, axis=2)
     if prefill:
@@ -107,6 +115,137 @@ def _split_cfg(cfg):
     """(base TransformerConfig, MoEConfig | None) from either config."""
     base = getattr(cfg, "base", None)
     return (base, cfg) if base is not None else (cfg, None)
+
+
+# --------------------------------------------------------------------------- #
+# Paged decode (the serving tier's cache discipline; serving/kv_pool.py owns
+# page allocation — the MATH lives here, next to the dense reference it must
+# match bitwise)
+# --------------------------------------------------------------------------- #
+
+
+def prefill_cached(params: Dict, cfg, tokens: jax.Array,
+                   last_idx: jax.Array, total: int, *,
+                   tp_layout: bool = False):
+    """Serving prefill: tokens (B, Pb) right-padded prompts, ``last_idx``
+    (B,) the index of each row's final REAL token, ``total`` (static) the
+    cache length to preallocate. Returns (logits at last_idx (B, V), dense
+    per-layer caches holding the prompt's K/V — the pool scatters these
+    into pages).
+
+    Padding rows write garbage K/V at positions > last_idx; decode's
+    visibility mask never exposes a position before the decode loop has
+    overwritten it with a real token's K/V, so the garbage is inert (the
+    same argument that makes recycled, un-zeroed pages safe)."""
+    bcfg, moe_cfg = _split_cfg(cfg)
+    b, pb = tokens.shape
+    dh = bcfg.d_model // bcfg.n_heads
+    caches = tuple(
+        (jnp.zeros((b, bcfg.n_heads, total, dh), jnp.float32),
+         jnp.zeros((b, bcfg.n_heads, total, dh), jnp.float32))
+        for _ in range(bcfg.n_layers))
+    x = embed_tokens(params, tokens, pos_offset=0)
+    new_caches = []
+    for i in range(bcfg.n_layers):
+        blk = params[f"block{i}"]
+        x, ck, cv = _block_cached(bcfg, x, blk, *caches[i], 0,
+                                  moe_cfg=moe_cfg, prefill=True,
+                                  tp_layout=tp_layout)
+        new_caches.append((ck, cv))
+    logits = lm_head(params, x)                      # (B, Pb, V)
+    picked = jnp.take_along_axis(
+        logits, last_idx[:, None, None].astype(jnp.int32), axis=1)
+    return picked[:, 0], tuple(new_caches)
+
+
+def _attend_paged(q, ck, cv, pos):
+    """q (B,H,1,Dh) against gathered page caches (B,H,T,Dh) with per-ROW
+    positions: key j is visible to row b iff j <= pos[b]. Identical math
+    to :func:`_attend_cached` (f32 scores, -inf mask, softmax) — only the
+    mask is ragged, which is what lets one decode dispatch carry sequences
+    at different positions."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                        ck.astype(jnp.float32)) / np.sqrt(dh)
+    t = ck.shape[2]
+    visible = (jnp.arange(t)[None, None, None, :]
+               <= pos[:, None, None, None])          # (B,1,1,T)
+    scores = jnp.where(visible, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, cv.astype(jnp.float32))
+
+
+def _block_paged(cfg: TransformerConfig, x, blk, pk, pv, page_table,
+                 slot_pages, slots, pos, *, tp_layout=False):
+    """One decoder block over PAGED caches: scatter this token's K/V into
+    each row's (page, slot), gather the row's pages back into a (B,H,T,Dh)
+    view, attend with the ragged mask. ``tp_layout`` (static) marks
+    head-major wqkv rows (models/transformer.py ``to_tp_layout``) so a
+    tp-sharded executor reshapes per-head instead of per-projection."""
+    b, s, _ = x.shape                                # s == 1
+    dh = cfg.d_model // cfg.n_heads
+    h = _layer_norm(x, blk["ln1_g"], blk["ln1_b"])
+    qkv = _dense(h, blk["wqkv"])
+    if tp_layout:
+        qkv = qkv.reshape(b, s, cfg.n_heads, 3, dh)
+        q, k, v = (qkv[:, :, :, j].swapaxes(1, 2) for j in range(3))
+    else:
+        qkv = qkv.reshape(b, s, 3, cfg.n_heads, dh)
+        q, k, v = (qkv[:, :, j].swapaxes(1, 2) for j in range(3))
+    # (B,H,1,Dh) -> per-row scatter at [(page, slot)]; inactive rows point
+    # at the scratch page, so their writes are harmless by construction
+    pk = pk.at[slot_pages, :, slots, :].set(k[:, :, 0, :].astype(pk.dtype))
+    pv = pv.at[slot_pages, :, slots, :].set(v[:, :, 0, :].astype(pv.dtype))
+    # page-table indirection: (B, P_seq) -> (B, P_seq, H, psz, Dh) ->
+    # (B, H, P_seq*psz, Dh). Pages sit in sequence order, so gathered
+    # index j IS absolute position j — the dense cache view, rebuilt.
+    ck = pk[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, cfg.n_heads, -1, dh)
+    cv = pv[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, cfg.n_heads, -1, dh)
+    att = _attend_paged(q, ck, cv, pos)
+    att = att.swapaxes(1, 2).reshape(b, s, cfg.d_model)
+    x = x + _dense(att, blk["wo"]).astype(x.dtype)
+    return ffn_sublayer(x, blk), pk, pv
+
+
+def paged_decode_step(params: Dict, cfg, tok: jax.Array, caches,
+                      page_table: jax.Array, pos: jax.Array, *,
+                      tp_layout: bool = False):
+    """ONE token for every row against paged KV caches — the serving
+    decode step (admit/retire between calls never reshapes anything).
+
+    tok (B,) int32 — the token each row feeds in; ``caches`` — per-layer
+    (pk, pv) page pools shaped (num_pages, H, page_size, Dh), SHARED by
+    all rows; page_table (B, max_pages) int32 — each row's pages in
+    sequence order, unused entries pointing at page 0 (the reserved
+    scratch page); pos (B,) int32 — the absolute position this token is
+    written at. Returns (logits (B, V), updated caches).
+
+    Inactive rows (padding up to the compiled batch rung): page_table row
+    all-scratch, pos 0, tok 0 — their writes land in scratch and their
+    logits row is garbage the scheduler never reads."""
+    bcfg, moe_cfg = _split_cfg(cfg)
+    if moe_cfg is not None:
+        raise NotImplementedError(
+            "paged decode serves dense TransformerConfig models; MoE "
+            "decode stays on the dense-cache generate() path")
+    psz = caches[0][0].shape[2]
+    pos = pos.astype(jnp.int32)
+    slot_pages = jnp.take_along_axis(
+        page_table, (pos // psz)[:, None], axis=1)[:, 0]
+    slots = pos % psz
+    x = (params["embed"]["w"][tok[:, None]]
+         + params["pos"]["w"][pos][:, None, :])
+    new_caches = []
+    for i in range(bcfg.n_layers):
+        blk = params[f"block{i}"]
+        pk, pv = caches[i]
+        x, pk, pv = _block_paged(bcfg, x, blk, pk, pv, page_table,
+                                 slot_pages, slots, pos,
+                                 tp_layout=tp_layout)
+        new_caches.append((pk, pv))
+    return lm_head(params, x)[:, -1], tuple(new_caches)
 
 
 def generate(params: Dict, cfg, prompt: jax.Array,

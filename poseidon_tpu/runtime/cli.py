@@ -607,14 +607,155 @@ def build_serving_fleet(model: str, weights: str, buckets: str,
                                 max_queue=max_queue, **manager_kw)
 
 
+LLM_PRESETS = ("tiny", "gpt_small")
+
+
+def _resolve_llm_knobs(args) -> dict:
+    """The LLM serving knobs through TunedPlan resolution (same idiom as
+    :func:`_resolve_serve_buckets`): a persisted plan's measured
+    ``llm_page_size``/``llm_decode_rungs``/``llm_prompt_buckets`` win over
+    the built-in defaults; the source is logged either way."""
+    from .metrics import log
+    from .tuned_plan import BUILTIN_DEFAULTS, load_plan
+
+    keys = ("llm_page_size", "llm_decode_rungs", "llm_prompt_buckets")
+    knobs = {k: BUILTIN_DEFAULTS[k] for k in keys}
+    if getattr(args, "tuned_plan", "auto") != "off":
+        doc = load_plan(args.model)
+        hits = {k: (doc or {}).get("knobs", {}).get(k) for k in keys}
+        knobs.update({k: v for k, v in hits.items() if v})
+        if any(hits.values()):
+            log(f"[tuned_plan] llm serving knobs {knobs} "
+                f"(plan {str((doc or {}).get('key', '?'))[:12]})")
+    return knobs
+
+
+def _build_generate_executor(preset: str, knobs: dict, device=None):
+    """A warmed paged-KV :class:`GenerateExecutor` over a named transformer
+    preset. ``--generate`` serving has no snapshot format yet, so params
+    are preset-initialized (the same smoke contract as an empty
+    ``--weights`` on the CNN path)."""
+    import jax
+    from ..models.transformer import (TransformerConfig, gpt_small_config,
+                                      init_params)
+    from ..serving.continuous import GenerateExecutor, parse_rungs
+
+    if preset == "gpt_small":
+        cfg = gpt_small_config(max_seq=512, remat=False)
+    elif preset == "tiny":
+        cfg = TransformerConfig(vocab_size=256, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=128, max_seq=128)
+    else:
+        raise SystemExit(
+            f"--generate serves a transformer preset, not a deploy "
+            f"prototxt; --model must be one of {'|'.join(LLM_PRESETS)} "
+            f"(got {preset!r})")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    # a preset smaller than the default ladder drops the buckets it
+    # cannot hold rather than refusing to serve
+    buckets = tuple(b for b in parse_rungs(knobs["llm_prompt_buckets"])
+                    if b < cfg.max_seq)
+    return GenerateExecutor(
+        cfg, params, page_size=int(knobs["llm_page_size"]),
+        decode_rungs=parse_rungs(knobs["llm_decode_rungs"]),
+        prompt_buckets=buckets, device=device)
+
+
+def _cmd_serve_generate(args) -> int:
+    """The LLM branch of ``serve``: paged-KV continuous batching behind
+    the same front door — ``generate`` wire op, streaming ``gen_chunk``
+    frames, fleet routing/failover when ``--replicas > 1``."""
+    import json
+    import signal
+
+    from ..config import fleet_config
+    from ..serving.server import InferenceServer
+    from .metrics import log
+
+    _enable_compile_cache_from_args(args)
+    if args.weights or args.watch:
+        raise SystemExit("--generate serves preset-initialized params; "
+                         "--weights/--watch have no LLM snapshot format "
+                         "to load yet")
+    knobs = _resolve_llm_knobs(args)
+    replicas = max(1, getattr(args, "replicas", 1))
+    fleet_mode = replicas > 1 or bool(getattr(args, "devices", ""))
+    manager = None
+    if fleet_mode:
+        from ..serving.fleet import ReplicaManager
+        devices = _resolve_fleet_devices(getattr(args, "devices", ""),
+                                         replicas)
+
+        def factory(device):
+            return _build_generate_executor(args.model, knobs,
+                                            device=device)
+
+        manager = ReplicaManager.build(factory, replicas, devices=devices,
+                                       max_queue=args.max_queue)
+        ref = manager.reference_executor()
+        log(f"serve: warmed {len(manager.replicas)} generate replicas "
+            f"({args.model}, page_size={ref.page_size}, "
+            f"rungs={ref.decode_rungs}, buckets={ref.prompt_buckets})")
+    else:
+        executor = _build_generate_executor(args.model, knobs)
+        log(f"serve: warmed generate executor ({args.model}, "
+            f"page_size={executor.page_size}, "
+            f"rungs={executor.decode_rungs}, "
+            f"buckets={executor.prompt_buckets})")
+    if args.host not in ("127.0.0.1", "localhost", "::1"):
+        log(f"serve: WARNING: binding {args.host!r} — the wire format is "
+            f"pickled frames (arbitrary code execution for anyone who can "
+            f"connect); serve only on loopback or a trusted network")
+    metrics_port = getattr(args, "metrics_port", -1)
+    server = InferenceServer(
+        executor=None if fleet_mode else executor,
+        fleet=manager,
+        host=args.host, port=args.port, max_queue=args.max_queue,
+        default_deadline_s=(args.deadline_ms / 1e3
+                            if args.deadline_ms > 0 else None),
+        stats_refresh_s=(fleet_config().stats_refresh_s
+                         if fleet_mode or metrics_port >= 0 else 0.0))
+    log(f"serve: listening on {server.host}:{server.port} (generate op"
+        + (f", {replicas} replicas)" if fleet_mode else ")"))
+    metrics_srv = None
+    if metrics_port >= 0:
+        from .metrics import MetricsServer
+        server.stats_snapshot()
+        metrics_srv = MetricsServer(server.stats, port=metrics_port)
+        log(f"serve: metrics endpoint on "
+            f"http://127.0.0.1:{metrics_srv.port}/")
+
+    def _graceful(signum, frame):
+        log(f"serve: signal {signum}; draining in-flight requests")
+        server.request_stop()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        server.wait_until_stopped()
+    except KeyboardInterrupt:
+        pass
+    server.shutdown(drain=True)
+    if metrics_srv is not None:
+        metrics_srv.close()
+    print(json.dumps({"serving_final_stats": server.stats_snapshot()}),
+          flush=True)
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Serve a trained snapshot over TCP: dynamic micro-batching, a
     shape-bucketed AOT compile cache, checkpoint hot-reload, and graceful
     drain on SIGTERM/SIGINT (exit 0, no request silently dropped).
     ``--replicas N`` puts a replica fleet behind the same front door:
-    least-loaded routing, per-replica health/failover, rolling reload."""
+    least-loaded routing, per-replica health/failover, rolling reload.
+    ``--generate`` serves a transformer preset through the paged-KV
+    continuous batcher instead (the ``generate`` wire op)."""
     import json
     import signal
+
+    if getattr(args, "generate", False):
+        return _cmd_serve_generate(args)
 
     from ..config import fleet_config
     from ..serving.reloader import CheckpointReloader, FleetReloader
@@ -1253,6 +1394,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="persistent XLA compile cache: a restarted "
                          "replica's bucket warm-up compiles become disk "
                          "reads (same flag as train; empty = off)")
+    sv.add_argument("--generate", action="store_true",
+                    help="LLM decode serving: --model names a transformer "
+                         "preset (tiny|gpt_small) served through the "
+                         "paged-KV continuous batcher — 'generate' wire "
+                         "op with streaming gen_chunk frames; page size/"
+                         "decode rungs/prompt buckets resolve through the "
+                         "persisted TunedPlan")
     sv.set_defaults(fn=cmd_serve)
 
     bs = sub.add_parser(

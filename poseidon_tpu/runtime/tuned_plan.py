@@ -76,6 +76,12 @@ BUILTIN_DEFAULTS: Dict[str, Any] = {
     "max_in_flight": _PC.max_in_flight,
     "steps_per_dispatch": 1,
     "serve_buckets": "1,4,16,64",
+    # LLM serving (serving/continuous.py): KV page size, the decode-batch
+    # rung ladder, and the prompt-length prefill buckets
+    "llm_page_size": 64,
+    "llm_decode_rungs": "1,2,4,8",
+    "llm_prompt_buckets": "16,64,256",
+    "llm_replicas_tp": "",        # "RxT" replica×tp factorization; "" = auto
 }
 TRAIN_KNOBS = ("conv_layout", "conv_strategy", "arena_bucket_mb", "mesh",
                "device_prefetch", "max_in_flight", "steps_per_dispatch")
@@ -382,7 +388,29 @@ def search_space(smoke: bool, n_devices: int) -> Dict[str, List]:
         "serve_buckets": (["1,4", "1,2,4"] if smoke
                           else ["1,4,16,64", "1,8,32,64", "1,2,8,32,64"]),
         "mesh": _mesh_candidates(n_devices, smoke),
+        # LLM serving (serving/continuous.py): KV page size, decode-batch
+        # rung ladder, replica x tp factorization — all measured against a
+        # deep-overload burst through the continuous scheduler (the
+        # offered-load operating point the bench's goodput curve saturates
+        # at). The built-in default is always a candidate.
+        "llm_page_size": [16, 64] if smoke else [16, 64, 128],
+        "llm_decode_rungs": (["1,2,4,8", "1,4"] if smoke
+                             else ["1,2,4,8", "1,4,8", "1,2,4,8,16"]),
+        "llm_replicas_tp": _llm_factorizations(n_devices, smoke),
     }
+
+
+def _llm_factorizations(n_devices: int, smoke: bool) -> List[str]:
+    """Replica x tp candidates ("RxT") for the LLM fleet: all devices to
+    replicas (throughput), or half to tp2 (larger models per replica,
+    fewer rows in flight). Smoke keeps the single trivial arm (recorded,
+    never a silent cap)."""
+    if n_devices <= 1 or smoke:
+        return ["1x1"]
+    cands = [f"{n_devices}x1"]
+    if n_devices % 2 == 0:
+        cands.append(f"{n_devices // 2}x2")
+    return cands
 
 
 def _mesh_candidates(n_devices: int, smoke: bool) -> List[str]:
@@ -601,6 +629,78 @@ def _measure_serve_knob(candidates: List[str], windows: int, iters: int,
     return {spec: round(raw[spec] / n_requests[spec], 4) for spec in raw}
 
 
+def _measure_llm_knob(arm_specs: Dict[str, Tuple[int, str, int, int]],
+                      windows: int, iters: int) -> Dict[str, float]:
+    """ms-per-generated-token at DEEP OVERLOAD for each LLM serving arm.
+
+    ``arm_specs``: name -> (page_size, decode_rungs, replicas, tp). Every
+    arm serves the same burst of concurrent generate requests (more
+    requests than any rung holds, i.e. the saturated end of the offered-
+    load curve — where the knob choice actually matters) through real
+    :class:`ContinuousScheduler` instances over a tiny probe transformer;
+    interleaved windows + min-of-k as everywhere else. tp > 1 arms build
+    a (1,1,tp) named mesh per replica."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from ..config import MeshConfig
+    from ..models.transformer import TransformerConfig, init_params
+    from ..serving.continuous import GenerateExecutor, parse_rungs
+
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_heads=4,
+                            n_layers=2, max_seq=256)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    p_len, max_new, n_req = 8, 8, 12
+    prompts = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(n_req, p_len)).astype(np.int32)
+    arms: Dict[str, Callable] = {}
+    all_scheds = []
+    for aname, (page, rungs, reps, tp) in arm_specs.items():
+        scheds = []
+        for _ in range(int(reps)):
+            mesh_cfg = (MeshConfig(data=1, fsdp=1, tp=int(tp))
+                        if int(tp) > 1 else None)
+            ex = GenerateExecutor(
+                cfg, params, page_size=int(page),
+                decode_rungs=parse_rungs(rungs), prompt_buckets=(p_len,),
+                max_seq_len=cfg.max_seq, default_max_new=max_new,
+                mesh_cfg=mesh_cfg)
+            scheds.append(ex.make_batcher(max_queue=n_req))
+        all_scheds.extend(scheds)
+
+        def run(scheds=scheds):
+            errs: List[BaseException] = []
+
+            def worker(i):
+                try:
+                    scheds[i % len(scheds)].submit(
+                        {"prompt": prompts[i], "max_new": max_new},
+                        timeout_s=120.0)
+                except BaseException as e:  # noqa: BLE001 — surface below
+                    errs.append(e)
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(n_req)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+
+        arms[aname] = run
+    try:
+        raw = interleaved_min_ms(arms, windows=windows, iters=iters,
+                                 warmup=1)
+    finally:
+        for s in all_scheds:
+            s.close(drain=False, timeout_s=5.0)
+    per_tok = n_req * max_new
+    return {name: round(raw[name] / per_tok, 4) for name in raw}
+
+
 def _conv_strategy_rows(net_param, shapes, conv_layout: str,
                         cache_dir: str) -> Dict[str, Dict]:
     """Run the PR-11 per-layer conv tuner for this model (persisting the
@@ -800,6 +900,41 @@ def run_tune(model: str, *, smoke: bool = False, force: bool = False,
              serve_buckets,
              "measured" + ("" if deploy else " (synthetic probe net)"))
 
+    # ---- LLM serving: page size, rung ladder, replica x tp --------------- #
+    # greedy coordinate descent at the deep-overload operating point (the
+    # saturated end of the offered-load curve bench.py serving_llm sweeps);
+    # each later knob is measured under the earlier winners
+    llm_page = int(BUILTIN_DEFAULTS["llm_page_size"])
+    llm_rungs = str(BUILTIN_DEFAULTS["llm_decode_rungs"])
+    llm_rt = str(BUILTIN_DEFAULTS["llm_replicas_tp"])
+    if "llm_page_size" not in skipped:
+        cands = space["llm_page_size"]
+        timings = _measure_llm_knob(
+            {str(p): (p, llm_rungs, 1, 1) for p in cands}, windows, iters)
+        llm_page = int(min(timings, key=timings.get))
+        note("llm_page_size", cands, timings, llm_page, "measured")
+    if "llm_decode_rungs" not in skipped:
+        cands = space["llm_decode_rungs"]
+        timings = _measure_llm_knob(
+            {r: (llm_page, r, 1, 1) for r in cands}, windows, iters)
+        llm_rungs = min(timings, key=timings.get)
+        note("llm_decode_rungs", cands, timings, llm_rungs, "measured")
+    if "llm_replicas_tp" not in skipped:
+        cands = space["llm_replicas_tp"]
+        if len(cands) == 1:
+            llm_rt = cands[0]
+            note("llm_replicas_tp", cands, {}, llm_rt,
+                 "only-candidate" + (" (smoke skips the fleet arms)"
+                                     if smoke and n_devices > 1 else ""))
+        else:
+            specs = {}
+            for c in cands:
+                reps, tp = (int(t) for t in c.split("x"))
+                specs[c] = (llm_page, llm_rungs, reps, tp)
+            timings = _measure_llm_knob(specs, windows, iters)
+            llm_rt = min(timings, key=timings.get)
+            note("llm_replicas_tp", cands, timings, llm_rt, "measured")
+
     search_cost_s = round(time.perf_counter() - t_start, 2)
     doc = {
         "version": PLAN_VERSION,
@@ -819,6 +954,12 @@ def run_tune(model: str, *, smoke: bool = False, force: bool = False,
             "device_prefetch": int(pf),
             "max_in_flight": int(mif),
             "serve_buckets": serve_buckets,
+            "llm_page_size": llm_page,
+            "llm_decode_rungs": llm_rungs,
+            # prompt buckets ride the defaults (prompt-length DISTRIBUTION
+            # is workload data the probe net cannot stand in for)
+            "llm_prompt_buckets": str(BUILTIN_DEFAULTS["llm_prompt_buckets"]),
+            "llm_replicas_tp": llm_rt,
         },
         "trials": trials,
         "ab": ab,

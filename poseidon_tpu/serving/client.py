@@ -98,7 +98,84 @@ class ServingClient:
         self.reconnects += 1
         return reply
 
+    def _stream_rpc(self, msg: Dict, on_tokens: Callable) -> Dict:
+        """Send one request and consume ``gen_chunk`` frames until the
+        final reply. Reconnect-and-RESEND is still safe mid-stream: each
+        chunk carries the CUMULATIVE tokens, so a restarted generation
+        just re-plays the prefix through ``on_tokens``."""
+        def exchange(sock: socket.socket) -> Dict:
+            send_frame(sock, msg)
+            while True:
+                reply = recv_frame(sock)
+                if isinstance(reply, dict) and \
+                        reply.get("kind") == "gen_chunk":
+                    try:
+                        on_tokens(list(reply["tokens"]))
+                    except Exception:  # noqa: BLE001 — a broken sink must
+                        pass           # not kill the stream consumption
+                    continue
+                return reply
+
+        try:
+            return exchange(self._sock)
+        except (OSError, EOFError) as e:
+            first_err = e
+
+        def attempt() -> Dict:
+            sk = self._dial()
+            try:
+                out = exchange(sk)
+            except BaseException:
+                sk.close()
+                raise
+            old, self._sock = self._sock, sk
+            try:
+                old.close()
+            except OSError:
+                pass
+            return out
+
+        try:
+            reply = retry_with_backoff(
+                attempt, deadline=self.retry_deadline_s,
+                base=self.backoff_base_s, cap=self.backoff_cap_s,
+                rng=self._rng, retry_on=(OSError, EOFError))
+        except (OSError, EOFError) as e:
+            raise ConnectionError(
+                f"server unreachable after {self.retry_deadline_s}s "
+                f"(first error: {type(first_err).__name__}: {first_err})"
+            ) from e
+        self.reconnects += 1
+        return reply
+
     # ---- ops -------------------------------------------------------------- #
+    def generate(self, prompt, max_new: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 on_tokens: Optional[Callable] = None) -> Dict:
+        """LLM decode: returns ``{"tokens", "n_new", "prompt_len"}``.
+        ``on_tokens`` (optional) turns on streaming — called with the
+        cumulative generated-token list as decode progresses."""
+        inputs: Dict = {"prompt": np.asarray(prompt, np.int32)}
+        if max_new is not None:
+            inputs["max_new"] = int(max_new)
+        if eos_id is not None:
+            inputs["eos_id"] = int(eos_id)
+        msg: Dict = {"kind": "generate", "inputs": inputs}
+        if deadline_ms is not None:
+            msg["deadline_ms"] = float(deadline_ms)
+        if on_tokens is not None:
+            msg["stream"] = True
+            reply = self._stream_rpc(msg, on_tokens)
+        else:
+            reply = self._rpc(msg)
+        if not reply.get("ok"):
+            raise ServingError(
+                str(reply.get("error", "request refused")),
+                shed=bool(reply.get("shed")),
+                deadline_exceeded=bool(reply.get("deadline_exceeded")))
+        return reply["outputs"]
+
     def infer(self, inputs: Dict[str, np.ndarray],
               deadline_ms: Optional[float] = None) -> Dict[str, np.ndarray]:
         msg: Dict = {"kind": "infer", "inputs": inputs}
@@ -144,7 +221,8 @@ def run_load(addr: Tuple[str, int],
              n_requests: int = 200, concurrency: int = 4,
              deadline_ms: Optional[float] = None,
              retry_deadline_s: float = 10.0,
-             offered_rps: Optional[float] = None) -> Dict:
+             offered_rps: Optional[float] = None,
+             op: str = "infer") -> Dict:
     """Drive ``n_requests`` inferences through ``concurrency`` persistent
     client connections; returns p50/p99/goodput plus shed/error counts.
 
@@ -167,13 +245,21 @@ def run_load(addr: Tuple[str, int],
     ``make_inputs(i)`` builds request i's input dict (vary batch sizes to
     exercise the bucket ladder). Sheds are counted, not retried — a bench
     that silently retried its way around backpressure would report a
-    throughput the server cannot actually sustain."""
+    throughput the server cannot actually sustain.
+
+    ``op="generate"`` drives the LLM decode op instead: ``make_inputs(i)``
+    then returns ``generate`` keyword arguments (prompt/max_new/eos_id)
+    and the summary gains ``tokens`` + ``goodput_tps`` (generated tokens
+    per second over accepted requests — the LLM serving goodput unit)."""
+    if op not in ("infer", "generate"):
+        raise ValueError(f"op must be infer|generate, got {op!r}")
     if offered_rps is not None and offered_rps <= 0:
         # a zero rate would ZeroDivisionError inside every worker thread
         # (which dies silently) — refuse it loudly at the call site
         raise ValueError(f"offered_rps must be > 0, got {offered_rps}")
     lat = LatencyWindow(maxlen=max(2048, n_requests))
     counters = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+    tokens = {"v": 0}
     late = {"v": 0}
     counters_lock = threading.Lock()
     next_i = {"v": 0}
@@ -200,7 +286,13 @@ def run_load(addr: Tuple[str, int],
                             late["v"] += 1
                 t0 = time.monotonic()
                 try:
-                    cli.infer(make_inputs(i), deadline_ms=deadline_ms)
+                    if op == "generate":
+                        out = cli.generate(deadline_ms=deadline_ms,
+                                           **make_inputs(i))
+                        with counters_lock:
+                            tokens["v"] += int(out.get("n_new", 0))
+                    else:
+                        cli.infer(make_inputs(i), deadline_ms=deadline_ms)
                     lat.record(time.monotonic() - t0)
                     key = "ok"
                 except ServingError as e:
@@ -232,6 +324,11 @@ def run_load(addr: Tuple[str, int],
         "p99_ms": summary.get("p99_ms"),
         "mean_ms": summary.get("mean_ms"),
     }
+    if op == "generate":
+        out.update({
+            "tokens": tokens["v"],
+            "goodput_tps": round(tokens["v"] / wall, 2),
+        })
     if offered_rps is not None:
         sent = sum(counters.values())
         out.update({

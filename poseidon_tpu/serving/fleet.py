@@ -101,9 +101,18 @@ class Replica:
             self._attach_batcher()
 
     def _attach_batcher(self) -> None:
-        self.batcher = DynamicBatcher(self.executor,
-                                      max_delay_s=self.max_delay_s,
-                                      max_queue=self.max_queue)
+        # an executor that brings its own scheduler (GenerateExecutor's
+        # ContinuousScheduler) plugs in here; routing, failover, rolling
+        # reload, and stats compose unchanged — a replica that schedules
+        # sequences instead of micro-batches is still just a replica
+        mk = getattr(self.executor, "make_batcher", None)
+        if mk is not None:
+            self.batcher = mk(max_delay_s=self.max_delay_s,
+                              max_queue=self.max_queue)
+        else:
+            self.batcher = DynamicBatcher(self.executor,
+                                          max_delay_s=self.max_delay_s,
+                                          max_queue=self.max_queue)
 
     def load(self) -> float:
         """The routing signal (see module docstring). A replica with no

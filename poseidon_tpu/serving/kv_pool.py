@@ -1,0 +1,229 @@
+"""Paged KV-cache pool: fixed-size pages from one preallocated device pool.
+
+The serving tier's answer to LLM decode memory (the vLLM discipline,
+restated in this repo's AOT idiom): every sequence's KV cache is a list of
+fixed-size PAGES drawn from one preallocated per-layer pool, and the
+decode step reads them through a page-table indirection
+(models/generate.py ``paged_decode_step``). Admitting or retiring a
+sequence therefore touches only the host-side free list — the device
+arrays never reshape, so every decode-batch rung stays AOT-compiled
+forever (the BucketedExecutor lesson applied to caches instead of inputs).
+
+Layout: one pool per layer per K/V, shaped ``(num_pages, n_heads,
+page_size, d_head)``. ONE page table per sequence is shared by every
+layer — page p means "page p in every layer's pool", so a sequence's
+allocation is a single list of page ids. Page 0 is the reserved SCRATCH
+page: inactive decode rows point their table at it, making their writes
+harmless by construction (no masking inside the compiled step).
+
+Pages are never zeroed on free. A recycled page's stale values are
+unreachable: the ragged visibility mask exposes position j only after the
+owning sequence has overwritten it — the same argument that makes the
+prompt-bucket padding rows inert.
+
+Admission policy: capacity for the WHOLE request (prompt + max_new,
+page-aligned) is reserved at admission, so a running sequence can never
+hit pool exhaustion mid-flight — no preemption machinery, at the cost of
+interior fragmentation the autotuner's page-size knob trades against.
+
+Thread model: one scheduler thread owns alloc/free/write; the lock exists
+for the stats readers (``pages_free``/``snapshot`` from server handler
+threads) racing those mutations.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["PagedKVPool", "PoolExhausted"]
+
+
+class PoolExhausted(RuntimeError):
+    """Not enough free pages for an admission — the scheduler's signal to
+    keep the request queued until retirements free capacity."""
+
+
+class PagedKVPool:
+    """Preallocated per-layer K/V page pools + the host-side allocator.
+
+    ``cfg`` is a dense ``TransformerConfig``; ``num_pages`` counts the
+    usable pages PLUS the scratch page (page 0); ``max_seq_len`` bounds
+    any single sequence (prompt + generated) and fixes the page-table
+    width every decode rung compiles against."""
+
+    def __init__(self, cfg, num_pages: int, page_size: int,
+                 max_seq_len: Optional[int] = None, device=None,
+                 shardings=None):
+        import jax
+        import jax.numpy as jnp
+
+        if num_pages < 2:
+            raise ValueError(f"need >= 2 pages (one is scratch), "
+                             f"got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        self.cfg = cfg
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_seq_len = int(max_seq_len or cfg.max_seq)
+        # static page-table width: every rung compiles against it
+        self.max_pages_per_seq = -(-self.max_seq_len // self.page_size)
+        dh = cfg.d_model // cfg.n_heads
+        shape = (self.num_pages, cfg.n_heads, self.page_size, dh)
+
+        def alloc_pool():
+            z = jnp.zeros(shape, jnp.float32)
+            if shardings is not None:
+                z = jax.device_put(z, shardings)
+            elif device is not None:
+                z = jax.device_put(z, device)
+            return z
+
+        self.caches: Tuple = tuple((alloc_pool(), alloc_pool())
+                                   for _ in range(cfg.n_layers))
+        self._lock = threading.Lock()
+        # LIFO free list (recently-freed pages are cache-warm); page 0 is
+        # the scratch page and never allocated
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._seq_pages: Dict[int, List[int]] = {}
+        self.allocs = 0
+        self.frees = 0
+        self.peak_pages_used = 0
+        self._scatter = None          # built lazily (jax import at use)
+
+    # ---- capacity ------------------------------------------------------- #
+    def pages_for(self, total_len: int) -> int:
+        """Pages a sequence of ``total_len`` positions reserves."""
+        return -(-int(total_len) // self.page_size)
+
+    @property
+    def pages_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def pages_used(self) -> int:
+        with self._lock:
+            return (self.num_pages - 1) - len(self._free)
+
+    def all_free(self) -> bool:
+        """The leak check: after a full drain every page is back."""
+        with self._lock:
+            return len(self._free) == self.num_pages - 1 \
+                and not self._seq_pages
+
+    def can_admit(self, total_len: int) -> bool:
+        if total_len > self.max_seq_len:
+            raise ValueError(f"sequence of {total_len} positions exceeds "
+                             f"pool max_seq_len {self.max_seq_len}")
+        with self._lock:
+            return self.pages_for(total_len) <= len(self._free)
+
+    # ---- alloc / free --------------------------------------------------- #
+    def alloc(self, seq_id: int, total_len: int) -> List[int]:
+        """Reserve every page a sequence of ``total_len`` positions will
+        ever touch. Raises :class:`PoolExhausted` without allocating
+        anything (all-or-nothing, so a failed admission leaks nothing)."""
+        n = self.pages_for(total_len)
+        with self._lock:
+            if seq_id in self._seq_pages:
+                raise ValueError(f"seq {seq_id} already holds pages")
+            if n > len(self._free):
+                raise PoolExhausted(
+                    f"need {n} pages, {len(self._free)} free "
+                    f"(pool {self.num_pages - 1})")
+            pages = [self._free.pop() for _ in range(n)]
+            self._seq_pages[seq_id] = pages
+            self.allocs += 1
+            used = (self.num_pages - 1) - len(self._free)
+            self.peak_pages_used = max(self.peak_pages_used, used)
+            return list(pages)
+
+    def free(self, seq_id: int) -> int:
+        """Retire a sequence: its pages return to the free list
+        IMMEDIATELY (no zeroing — see module docstring). Idempotent."""
+        with self._lock:
+            pages = self._seq_pages.pop(seq_id, None)
+            if pages is None:
+                return 0
+            self._free.extend(pages)
+            self.frees += 1
+            return len(pages)
+
+    def pages_of(self, seq_id: int) -> List[int]:
+        with self._lock:
+            return list(self._seq_pages.get(seq_id, ()))
+
+    # ---- page tables ----------------------------------------------------- #
+    def table_row(self, seq_id: int) -> np.ndarray:
+        """One sequence's page-table row, padded to the static width with
+        the scratch page."""
+        row = np.zeros((self.max_pages_per_seq,), np.int32)
+        pages = self.pages_of(seq_id)
+        row[:len(pages)] = pages
+        return row
+
+    def table(self, seq_ids: Sequence[Optional[int]]) -> np.ndarray:
+        """(R, max_pages) page table for one decode dispatch; ``None``
+        entries (inactive padding rows) get the all-scratch row."""
+        rows = np.zeros((len(seq_ids), self.max_pages_per_seq), np.int32)
+        for i, sid in enumerate(seq_ids):
+            if sid is not None:
+                rows[i] = self.table_row(sid)
+        return rows
+
+    # ---- prefill scatter -------------------------------------------------- #
+    def write_prefill(self, seq_id: int, dense_caches) -> None:
+        """Scatter a prefill's dense per-layer caches (B=1, shape
+        (1, H, T, Dh) with T page-aligned) into the sequence's first
+        T/page_size pages — the handoff from the prompt phase (dense,
+        flash-attention prefill) to the paged decode phase."""
+        import jax
+        import jax.numpy as jnp
+
+        t = int(dense_caches[0][0].shape[2])
+        if t % self.page_size:
+            raise ValueError(f"prefill cache length {t} is not "
+                             f"page-aligned (page_size {self.page_size})")
+        n = t // self.page_size
+        pages = self.pages_of(seq_id)
+        if n > len(pages):
+            raise ValueError(f"prefill needs {n} pages, seq {seq_id} "
+                             f"holds {len(pages)}")
+        if self._scatter is None:
+            h = self.cfg.n_heads
+            dh = self.cfg.d_model // self.cfg.n_heads
+            psz = self.page_size
+
+            def scatter(pools, dense, idx):
+                out = []
+                for (pk, pv), (ck, cv) in zip(pools, dense):
+                    npg = ck.shape[2] // psz
+                    rk = ck[0].reshape(h, npg, psz, dh).transpose(1, 0, 2, 3)
+                    rv = cv[0].reshape(h, npg, psz, dh).transpose(1, 0, 2, 3)
+                    out.append((pk.at[idx].set(rk), pv.at[idx].set(rv)))
+                return tuple(out)
+
+            # donated pools: the scatter updates in place; shape-keyed jit
+            # (one compile per prompt bucket) — serving never re-traces
+            self._scatter = jax.jit(scatter, donate_argnums=(0,))
+        idx = jnp.asarray(np.asarray(pages[:n], np.int32))
+        self.caches = self._scatter(self.caches, dense_caches, idx)
+
+    # ---- introspection ---------------------------------------------------- #
+    def snapshot(self) -> Dict:
+        with self._lock:
+            used = (self.num_pages - 1) - len(self._free)
+            return {
+                "num_pages": self.num_pages - 1,      # usable (sans scratch)
+                "page_size": self.page_size,
+                "pages_used": used,
+                "pages_free": len(self._free),
+                "peak_pages_used": self.peak_pages_used,
+                "sequences": len(self._seq_pages),
+                "allocs": self.allocs,
+                "frees": self.frees,
+            }

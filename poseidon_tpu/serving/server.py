@@ -16,6 +16,14 @@ Request protocol (pickled dicts, one frame per message):
   -> ``{"ok": False, "deadline_exceeded": True, "error": ...}`` when the
   per-request deadline expired in queue;
   -> ``{"ok": False, "error": ...}`` on malformed inputs.
+- ``{"kind": "generate", "inputs": {"prompt": 1-D int array, "max_new":
+  int?, "eos_id": int?}, "deadline_ms": float?, "stream": bool?}`` — LLM
+  decode through the continuous-batching scheduler (serving/continuous.py).
+  Same reply shapes as ``infer`` (``outputs`` = tokens/n_new/prompt_len);
+  with ``stream`` the reply frame is preceded by zero or more
+  ``{"kind": "gen_chunk", "tokens": [...]}`` frames carrying the
+  CUMULATIVE generated tokens (cumulative so a reconnect-resend or a
+  failover re-prefill restarts the stream without loss).
 - ``{"kind": "stats"}`` -> latency percentiles, queue depth, batch-fill
   ratio, shed count, reload count (the `/stats`-style introspection op).
 - ``{"kind": "reload"}`` -> force one hot-reload poll now (when a
@@ -70,7 +78,14 @@ class InferenceServer:
         self.reloader = reloader
         self.stats = stats or StatsRegistry()
         self.default_deadline_s = default_deadline_s
+        # an executor that brings its own scheduler (GenerateExecutor ->
+        # ContinuousScheduler) plugs in here, same hook as
+        # fleet.Replica._attach_batcher
+        mk = (getattr(executor, "make_batcher", None)
+              if executor is not None else None)
         self.batcher = (None if fleet is not None else
+                        mk(max_delay_s=max_delay_s, max_queue=max_queue)
+                        if mk is not None else
                         DynamicBatcher(executor, max_delay_s=max_delay_s,
                                        max_queue=max_queue))
         self.bad_frames = 0
@@ -141,7 +156,7 @@ class InferenceServer:
                     self._active_replies += 1
                 try:
                     try:
-                        reply = self._dispatch(msg)
+                        reply = self._dispatch(msg, conn)
                     except (ConnectionError, OSError):
                         return
                     except (KeyError, TypeError, ValueError) as e:
@@ -176,10 +191,12 @@ class InferenceServer:
             except OSError:
                 pass
 
-    def _dispatch(self, msg: Dict) -> Optional[Dict]:
+    def _dispatch(self, msg: Dict, conn=None) -> Optional[Dict]:
         kind = msg["kind"]
         if kind == "infer":
             return self._handle_infer(msg)
+        if kind == "generate":
+            return self._handle_generate(msg, conn)
         if kind == "stats":
             return {"ok": True, "stats": self.stats_snapshot()}
         if kind == "health":
@@ -218,6 +235,42 @@ class InferenceServer:
                         "params_version": rep.executor.params_version}
             outputs = self.batcher.submit(msg["inputs"],
                                           deadline_s=deadline_s)
+            return {"ok": True, "outputs": outputs,
+                    "params_version": self.executor.params_version}
+        except ShedError as e:
+            return {"ok": False, "shed": True, "error": str(e)}
+        except DeadlineError as e:
+            return {"ok": False, "deadline_exceeded": True, "error": str(e)}
+        except (ValueError, TimeoutError) as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _handle_generate(self, msg: Dict, conn=None) -> Dict:
+        """LLM decode: same admission/deadline error surface as ``infer``;
+        the batcher behind it is a ContinuousScheduler, so the request is
+        a SEQUENCE (admitted/retired per decode step), not a dispatch.
+
+        Streaming rides the scheduler's per-token callback: each chunk
+        frame carries the cumulative tokens so far, written from the
+        scheduler thread while this handler thread blocks in submit (the
+        final reply only goes out after the last chunk). A broken chunk
+        send kills the stream, never the sequence or the loop."""
+        deadline_ms = msg.get("deadline_ms")
+        deadline_s = (float(deadline_ms) / 1e3 if deadline_ms is not None
+                      else self.default_deadline_s)
+        inputs = dict(msg["inputs"])
+        if msg.get("stream") and conn is not None:
+            def emit(tokens, _conn=conn):
+                send_frame(_conn, {"kind": "gen_chunk",
+                                   "tokens": [int(t) for t in tokens]})
+            inputs["stream"] = emit
+        try:
+            if self.fleet is not None:
+                outputs, rep = self.fleet.submit(inputs,
+                                                 deadline_s=deadline_s)
+                return {"ok": True, "outputs": outputs,
+                        "replica": rep.index,
+                        "params_version": rep.executor.params_version}
+            outputs = self.batcher.submit(inputs, deadline_s=deadline_s)
             return {"ok": True, "outputs": outputs,
                     "params_version": self.executor.params_version}
         except ShedError as e:
@@ -269,8 +322,10 @@ class InferenceServer:
             "server_errors": self.server_errors,
             "connections": self.connections,
             "rows_served": self.executor.rows_served,
-            "rows_padded": self.executor.rows_padded,
-            "bucket_calls": dict(self.executor.calls),
+            # CNN-executor-only telemetry; a GenerateExecutor reports its
+            # paged/decode counters through the batcher snapshot instead
+            "rows_padded": getattr(self.executor, "rows_padded", 0),
+            "bucket_calls": dict(getattr(self.executor, "calls", {})),
             # per-rung fill: which compile slots dispatch real rows vs
             # padding (capacity signal for re-cutting the bucket ladder);
             # getattr: duck-typed test executors need not implement it

@@ -1,0 +1,84 @@
+"""serve --generate smoke: spawn the LLM serving front door as a real
+subprocess and drive it like a client would.
+
+CI (tier1.yml) runs this after the test sweep: it proves the CLI wiring
+end to end — preset resolution, port-0 bind + the parseable "listening
+on" line, the ``generate`` op over the socket, cumulative ``gen_chunk``
+streaming, the stats op, and a graceful SIGTERM drain to exit code 0.
+The pytest suite covers the same machinery in-process; this covers the
+one thing pytest can't — the packaged entry point users actually run.
+
+Usage: python scripts/serve_generate_smoke.py
+Exits non-zero on any failed check or a dirty server exit.
+"""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "poseidon_tpu", "serve", "--generate",
+         "--model", "tiny", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    try:
+        port = None
+        deadline = time.time() + 180
+        lines = []
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            m = re.search(r"listening on 127\.0\.0\.1:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        if not port:
+            print("FAIL: server never reported a port\n" + "".join(lines))
+            return 1
+
+        import numpy as np
+        from poseidon_tpu.serving.client import ServingClient
+
+        cli = ServingClient(("127.0.0.1", port))
+        out = cli.generate(np.arange(6, dtype=np.int32), max_new=5)
+        assert out["n_new"] == 5 and out["tokens"].shape == (5,), out
+
+        chunks = []
+        out2 = cli.generate(np.arange(6, dtype=np.int32), max_new=4,
+                            on_tokens=chunks.append)
+        assert [len(c) for c in chunks] == [1, 2, 3, 4], chunks
+        assert list(chunks[-1]) == [int(t) for t in out2["tokens"]], chunks
+
+        st = cli.stats()
+        assert st["rows_served"] > 0, st
+        cli.close()
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        if rc != 0:
+            print(f"FAIL: server exited {rc} after SIGTERM\n"
+                  + proc.stdout.read())
+            return 1
+        print("serve --generate smoke OK: tokens",
+              out["tokens"].tolist(), "rc", rc)
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,490 @@
+"""LLM serving suite (ISSUE 17): paged KV-cache pool + continuous
+batching + tp-sharded replicas behind the fleet front door.
+
+The contracts pinned here:
+
+- paged decode is BITWISE equal to the dense-cache ``generate`` path
+  (logits, not just argmax tokens) and the pool leaks nothing;
+- the continuous scheduler returns exactly the dense path's tokens under
+  concurrent submits, retires on EOS immediately, streams cumulative
+  chunks, sheds/deadlines explicitly, and its static mode gang-batches;
+- a tp2-sharded replica (GSPMD over the 8-device virtual CPU mesh from
+  conftest) matches the one-device output token-for-token;
+- kill-1-of-3 mid-generation through the real socket front door and the
+  fault proxy loses ZERO accepted requests (survivor re-prefills) and
+  frees every page;
+- rolling reload swaps generate replicas with zero request failures.
+
+Everything binds port 0 on loopback only; daemon threads only.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 64
+
+
+def _cfg():
+    from poseidon_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=VOCAB, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=128, max_seq=32)
+
+
+def _params(cfg, seed=0):
+    import jax
+    from poseidon_tpu.models.transformer import init_params
+    return init_params(cfg, jax.random.PRNGKey(seed))
+
+
+def _prompts(b, p, seed=1):
+    import jax
+    import jax.numpy as jnp
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed), (b, p),
+                                         0, VOCAB, dtype=jnp.int32))
+
+
+def _dense(params, cfg, prompt, max_new):
+    import jax.numpy as jnp
+    from poseidon_tpu.models.generate import generate
+    toks, logits = generate(params, cfg, jnp.asarray(prompt), max_new)
+    return np.asarray(toks), np.asarray(logits)
+
+
+def _executor(cfg, params, **kw):
+    from poseidon_tpu.serving.continuous import GenerateExecutor
+    kw.setdefault("page_size", 4)
+    kw.setdefault("decode_rungs", (1, 2, 4))
+    kw.setdefault("prompt_buckets", (8,))
+    kw.setdefault("max_seq_len", 24)
+    kw.setdefault("default_max_new", 6)
+    return GenerateExecutor(cfg, params, **kw)
+
+
+# --------------------------------------------------------------------------- #
+# paged decode parity (the refactor's bitwise contract)
+# --------------------------------------------------------------------------- #
+
+def test_paged_decode_bitwise_equals_dense_generate():
+    """Page-table indirection reconstructs the dense cache EXACTLY: the
+    per-step logits (not just the argmax) are bit-identical to
+    ``generate``'s, and freeing returns every page."""
+    import jax
+    import jax.numpy as jnp
+    from poseidon_tpu.models.generate import (paged_decode_step,
+                                              prefill_cached)
+    from poseidon_tpu.serving.kv_pool import PagedKVPool
+
+    cfg = _cfg()
+    params = _params(cfg)
+    B, P, MAX_NEW = 2, 6, 6
+    prompt = _prompts(B, P)
+    toks_d, logits_d = _dense(params, cfg, prompt, MAX_NEW)
+
+    pool = PagedKVPool(cfg, num_pages=16, page_size=4,
+                       max_seq_len=P + MAX_NEW)
+    pf = jax.jit(prefill_cached, static_argnames=("cfg", "total"))
+    step = jax.jit(lambda p, tok, caches, table, pos:
+                   paged_decode_step(p, cfg, tok, caches, table, pos))
+
+    toks_p = np.zeros((B, MAX_NEW), np.int64)
+    logits_p = np.zeros_like(logits_d)
+    seq_ids = list(range(B))
+    for b in seq_ids:
+        pool.alloc(b, P + MAX_NEW)
+        lg, caches = pf(params, cfg, jnp.asarray(prompt[b:b + 1]),
+                        jnp.asarray([P - 1], jnp.int32), total=8)
+        pool.write_prefill(b, caches)
+        logits_p[b, 0] = np.asarray(lg)[0]
+    toks_p[:, 0] = np.argmax(logits_p[:, 0], axis=-1)
+
+    table = jnp.asarray(pool.table(seq_ids))
+    pos = jnp.full((B,), P, jnp.int32)
+    tok = jnp.asarray(toks_p[:, 0].astype(np.int32))
+    caches = pool.caches
+    for i in range(1, MAX_NEW):
+        lg, caches = step(params, tok, caches, table, pos)
+        logits_p[:, i] = np.asarray(lg)
+        toks_p[:, i] = np.argmax(logits_p[:, i], axis=-1)
+        tok = jnp.asarray(toks_p[:, i].astype(np.int32))
+        pos = pos + 1
+    pool.caches = caches
+
+    np.testing.assert_array_equal(toks_d, toks_p)
+    assert np.array_equal(logits_d, logits_p), (
+        "paged decode logits drifted from the dense cache "
+        f"(max abs diff {np.abs(logits_d - logits_p).max()})")
+    for b in seq_ids:
+        pool.free(b)
+    assert pool.all_free()
+
+
+def test_pool_reserve_all_or_nothing_and_exhaustion():
+    """Admission reserves the WHOLE sequence budget up front: a request
+    that cannot get every page gets none, and retirement returns the
+    exact pages taken (no mid-flight exhaustion, no leak)."""
+    from poseidon_tpu.serving.kv_pool import PagedKVPool, PoolExhausted
+
+    cfg = _cfg()
+    pool = PagedKVPool(cfg, num_pages=5, page_size=4, max_seq_len=16)
+    # 4 usable pages (page 0 is scratch): 16 tokens = all 4 pages
+    pool.alloc(1, 16)
+    assert not pool.can_admit(4)
+    with pytest.raises(PoolExhausted):
+        pool.alloc(2, 4)
+    pool.free(1)
+    assert pool.all_free()
+    pool.alloc(3, 4)
+    pool.free(3)
+    assert pool.all_free()
+
+
+# --------------------------------------------------------------------------- #
+# continuous scheduler behavior
+# --------------------------------------------------------------------------- #
+
+def test_scheduler_matches_dense_eos_and_streaming():
+    """Concurrent submits through the iteration-level scheduler produce
+    exactly the dense path's tokens; EOS retires a sequence on the spot
+    (n_new == 1 when the first token is EOS); streaming chunks are
+    cumulative with the final chunk equal to the result."""
+    cfg = _cfg()
+    params = _params(cfg)
+    B, P, MAX_NEW = 3, 6, 6
+    prompt = _prompts(B, P)
+    toks_d, _ = _dense(params, cfg, prompt, MAX_NEW)
+
+    ex = _executor(cfg, params)
+    sched = ex.make_batcher(max_queue=16)
+    try:
+        results = [None] * B
+        errs = [None] * B
+
+        def worker(i):
+            try:
+                results[i] = sched.submit(
+                    {"prompt": prompt[i], "max_new": MAX_NEW}, timeout_s=30)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errs[i] = e
+
+        ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+              for i in range(B)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert errs == [None] * B
+        for i in range(B):
+            np.testing.assert_array_equal(results[i]["tokens"], toks_d[i])
+
+        eos = int(toks_d[0][0])
+        r = sched.submit({"prompt": prompt[0], "max_new": 6, "eos_id": eos})
+        assert r["n_new"] == 1 and int(r["tokens"][0]) == eos
+
+        chunks = []
+        r = sched.submit({"prompt": prompt[1], "max_new": 4,
+                          "stream": lambda t: chunks.append(list(t))})
+        assert [len(c) for c in chunks] == [1, 2, 3, 4]
+        assert chunks[-1] == [int(t) for t in r["tokens"]]
+
+        assert sched.wait_idle(10.0)
+        assert ex.pool.all_free(), "retirement leaked pages"
+        snap = sched.snapshot()
+        assert snap["admitted"] == snap["retired"] == B + 2
+    finally:
+        sched.close()
+
+
+def test_scheduler_sheds_and_deadlines_explicitly():
+    """A full queue sheds with ShedError (never a hang); a queued request
+    whose deadline lapses before admission surfaces DeadlineError; both
+    count in the scheduler's telemetry."""
+    from poseidon_tpu.serving.batcher import DeadlineError, ShedError
+    from poseidon_tpu.serving.continuous import ContinuousScheduler
+
+    cfg = _cfg()
+    params = _params(cfg)
+    prompt = _prompts(1, 6)[0]
+    ex = _executor(cfg, params)
+
+    gate = threading.Event()
+    real_decode = ex.decode
+
+    def slow_decode(tok, table, pos):
+        gate.wait(10.0)
+        return real_decode(tok, table, pos)
+
+    ex.decode = slow_decode
+    sched = ContinuousScheduler(ex, max_queue=1)
+    try:
+        holder = threading.Thread(
+            target=lambda: sched.submit({"prompt": prompt, "max_new": 6},
+                                        timeout_s=30),
+            daemon=True)
+        holder.start()
+        deadline = time.monotonic() + 5.0
+        while sched.inflight_rows == 0:
+            assert time.monotonic() < deadline, "first submit never admitted"
+            time.sleep(0.005)
+        # active row holds the loop inside decode; the 1-deep queue gets
+        # filled by a request whose deadline is already doomed to lapse
+        # before the loop can come back around to admit it
+        doomed_err = []
+
+        def doomed():
+            try:
+                sched.submit({"prompt": prompt, "max_new": 2},
+                             deadline_s=0.01, timeout_s=30)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                doomed_err.append(e)
+
+        q_filler = threading.Thread(target=doomed, daemon=True)
+        q_filler.start()
+        deadline = time.monotonic() + 5.0
+        while sched.queue_depth == 0:
+            assert time.monotonic() < deadline, "queue never filled"
+            time.sleep(0.005)
+        # the next submit meets a full queue: explicit shed, never a hang
+        with pytest.raises(ShedError):
+            sched.submit({"prompt": prompt, "max_new": 2})
+        assert sched.shed_count == 1
+        time.sleep(0.05)                 # the queued deadline lapses …
+        gate.set()                       # … before admission resumes
+        holder.join(timeout=30)
+        q_filler.join(timeout=30)
+        assert len(doomed_err) == 1 and isinstance(doomed_err[0],
+                                                   DeadlineError)
+        assert sched.deadline_expired >= 1
+        assert sched.wait_idle(10.0)
+        assert ex.pool.all_free()
+    finally:
+        gate.set()
+        sched.close()
+
+
+def test_static_mode_gang_admits_and_matches():
+    """The A/B control arm: static mode gang-admits into an EMPTY active
+    set only (no iteration-level backfill), still returns exactly the
+    dense tokens, and reports its mode in the snapshot."""
+    from poseidon_tpu.serving.continuous import ContinuousScheduler
+
+    cfg = _cfg()
+    params = _params(cfg)
+    B, P, MAX_NEW = 4, 6, 5
+    prompt = _prompts(B, P)
+    toks_d, _ = _dense(params, cfg, prompt, MAX_NEW)
+
+    ex = _executor(cfg, params)
+    ex.scheduler_mode = "static"
+    sched = ex.make_batcher(max_queue=16)
+    try:
+        assert sched.mode == "static"
+        results = [None] * B
+
+        def worker(i):
+            results[i] = sched.submit(
+                {"prompt": prompt[i], "max_new": MAX_NEW}, timeout_s=30)
+
+        ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+              for i in range(B)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        for i in range(B):
+            np.testing.assert_array_equal(results[i]["tokens"], toks_d[i])
+        assert sched.snapshot()["mode"] == "static"
+        assert ex.pool.all_free()
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------------------------- #
+# tp-sharded replica (PR-10 ShardingPlan composition)
+# --------------------------------------------------------------------------- #
+
+def test_tp2_sharded_replica_matches_one_device():
+    """A GenerateExecutor over a tp=2 named mesh (GSPMD, head-major
+    layout, sharded KV pool) produces token-for-token the one-device
+    dense output — the sharding is invisible to the serving contract."""
+    from poseidon_tpu.config import MeshConfig
+
+    cfg = _cfg()
+    params = _params(cfg)
+    B, P, MAX_NEW = 2, 6, 6
+    prompt = _prompts(B, P)
+    toks_d, _ = _dense(params, cfg, prompt, MAX_NEW)
+
+    ex = _executor(cfg, params, decode_rungs=(1, 2),
+                   mesh_cfg=MeshConfig(data=1, fsdp=1, tp=2))
+    sched = ex.make_batcher(max_queue=8)
+    try:
+        results = [None] * B
+
+        def worker(i):
+            results[i] = sched.submit(
+                {"prompt": prompt[i], "max_new": MAX_NEW}, timeout_s=60)
+
+        ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+              for i in range(B)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        for i in range(B):
+            np.testing.assert_array_equal(results[i]["tokens"], toks_d[i])
+        assert ex.pool.all_free()
+        assert ex.snapshot()["mesh"]
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------------------------- #
+# the wire: generate op + streaming over the socket front door
+# --------------------------------------------------------------------------- #
+
+def test_generate_over_socket_with_streaming_and_stats():
+    from poseidon_tpu.serving.client import ServingClient, run_load
+    from poseidon_tpu.serving.server import InferenceServer
+
+    cfg = _cfg()
+    params = _params(cfg)
+    prompt = _prompts(2, 6)
+    toks_d, _ = _dense(params, cfg, prompt, 6)
+
+    ex = _executor(cfg, params)
+    srv = InferenceServer(executor=ex)
+    cli = None
+    try:
+        cli = ServingClient(srv.addr)
+        out = cli.generate(prompt[0], max_new=6)
+        np.testing.assert_array_equal(out["tokens"], toks_d[0])
+
+        chunks = []
+        out = cli.generate(prompt[1], max_new=6, on_tokens=chunks.append)
+        assert [len(c) for c in chunks] == [1, 2, 3, 4, 5, 6]
+        np.testing.assert_array_equal(out["tokens"], toks_d[1])
+
+        r = run_load(srv.addr,
+                     lambda i: {"prompt": prompt[i % 2], "max_new": 4},
+                     n_requests=12, concurrency=3, op="generate")
+        assert r["ok"] == 12 and r["error"] == 0
+        assert r["tokens"] == 48 and r["goodput_tps"] > 0
+        st = cli.stats()
+        assert st["rows_served"] > 0
+    finally:
+        if cli is not None:
+            cli.close()
+        srv.shutdown()
+    assert ex.pool.all_free()
+
+
+# --------------------------------------------------------------------------- #
+# chaos: kill 1 of 3 mid-generation (the acceptance scenario)
+# --------------------------------------------------------------------------- #
+
+def _poisonable_executor(cfg, params):
+    """A real GenerateExecutor whose decode dies once ``die`` is set —
+    the replica-death lever for a scheduler of sequences (poisoning
+    decode, not prefill, kills replicas MID-generation)."""
+    ex = _executor(cfg, params)
+    ex.die = threading.Event()
+    real_decode = ex.decode
+
+    def decode(tok, table, pos):
+        if ex.die.is_set():
+            raise RuntimeError("device lost")
+        return real_decode(tok, table, pos)
+
+    ex.decode = decode
+    return ex
+
+
+def test_kill_one_of_three_mid_generation_chaos():
+    """3 generate replicas under sustained socket load; one dies
+    MID-GENERATION, then a full network partition on top. Zero accepted
+    requests lost (the fleet re-prefills on a survivor), the dead
+    replica's pages and the survivors' pools all return to free."""
+    from poseidon_tpu.runtime.faults import FaultProxy
+    from poseidon_tpu.serving.client import run_load
+    from poseidon_tpu.serving.fleet import DEAD, ReplicaManager
+    from poseidon_tpu.serving.server import InferenceServer
+
+    cfg = _cfg()
+    params = _params(cfg)
+    prompt = _prompts(4, 6)
+    exs = [_poisonable_executor(cfg, params) for _ in range(3)]
+    mgr = ReplicaManager(exs, max_queue=64)
+    srv = InferenceServer(fleet=mgr)
+    proxy = FaultProxy(srv.addr)
+    try:
+        box = {}
+
+        def load():
+            box["result"] = run_load(
+                proxy.addr,
+                lambda i: {"prompt": prompt[i % 4], "max_new": 4},
+                n_requests=120, concurrency=6, retry_deadline_s=10.0,
+                op="generate")
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        exs[0].die.set()                 # decode dies mid-generation
+        time.sleep(0.2)
+        proxy.sever_all()                # partition every connection
+        t.join(timeout=90.0)
+        assert not t.is_alive(), "load generator wedged"
+        r = box["result"]
+        # the invariant: only explicit sheds are lost, nothing errors
+        assert r["error"] == 0 and r["deadline"] == 0, r
+        assert r["ok"] + r["shed"] == 120, r
+        assert r["ok"] > 0 and r["tokens"] == r["ok"] * 4
+        assert mgr.state_counts()[DEAD] == 1
+        assert mgr.deaths == 1 and mgr.failovers >= 1
+        # survivors carried the load
+        assert exs[1].rows_served + exs[2].rows_served > 0
+    finally:
+        proxy.close()
+        srv.shutdown()
+    for i, ex in enumerate(exs):
+        assert ex.pool.all_free(), f"replica {i} leaked pages"
+
+
+# --------------------------------------------------------------------------- #
+# rolling reload over generate replicas
+# --------------------------------------------------------------------------- #
+
+def test_rolling_reload_swaps_generate_replicas():
+    """rolling_reload drains and swaps generate replicas one at a time;
+    afterwards every replica serves the NEW params (output flips to the
+    new dense reference) and versions bumped."""
+    from poseidon_tpu.serving.fleet import ReplicaManager
+
+    cfg = _cfg()
+    params_a = _params(cfg, seed=0)
+    params_b = _params(cfg, seed=9)
+    prompt = _prompts(1, 6)[0]
+    toks_a, _ = _dense(params_a, cfg, prompt[None, :], 5)
+    toks_b, _ = _dense(params_b, cfg, prompt[None, :], 5)
+    assert not np.array_equal(toks_a, toks_b), "seeds collide; bad fixture"
+
+    exs = [_executor(cfg, params_a) for _ in range(2)]
+    mgr = ReplicaManager(exs, max_queue=16)
+    try:
+        out, _ = mgr.submit({"prompt": prompt, "max_new": 5})
+        np.testing.assert_array_equal(out["tokens"], toks_a[0])
+        swapped = mgr.rolling_reload(params_b)
+        assert swapped == 2
+        assert mgr.max_concurrent_draining <= 1
+        for _ in range(4):
+            out, _ = mgr.submit({"prompt": prompt, "max_new": 5})
+            np.testing.assert_array_equal(out["tokens"], toks_b[0])
+        assert all(ex.params_version == 1 for ex in exs)
+    finally:
+        mgr.shutdown()
+    assert all(ex.pool.all_free() for ex in exs)

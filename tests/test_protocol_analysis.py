@@ -302,10 +302,15 @@ def test_shipped_schema_content_highlights():
                                           "worker", "full"}
     assert [k for k, v in ps["kinds"].items() if v["mutating"]] == ["push"]
     inf = golden["services"]["inference"]
-    assert set(inf["kinds"]) == {"infer", "stats", "health", "reload",
-                                 "bye"}
+    assert set(inf["kinds"]) == {"infer", "generate", "stats", "health",
+                                 "reload", "bye"}
     assert inf["unhandled_kinds"] == []
     assert "outputs" in inf["kinds"]["infer"]["reply_keys"]
+    gen = inf["kinds"]["generate"]
+    assert gen["mutating"] is False
+    assert gen["required_fields"] == ["inputs"]
+    assert "stream" in gen["optional_fields"]      # gen_chunk streaming opt-in
+    assert "tokens" in gen["reply_keys"]
 
 
 def test_schema_diff_detects_vocabulary_drift():
