@@ -2209,16 +2209,96 @@ def comms_main(argv: list | None = None) -> None:
     ap = argparse.ArgumentParser(prog="bench.py comms")
     ap.add_argument("--param_kb", type=int, default=1024,
                     help="dense flush size in KiB (default 1 MiB)")
-    ap.add_argument("--link_mbps", type=float, default=16.0,
-                    help="throttled link rate in Mbit/s (both directions)")
+    ap.add_argument("--link_mbps", type=float, default=0.0,
+                    help="throttled link rate in Mbit/s (both directions); "
+                         "0 = auto: measure this host's unthrottled "
+                         "push-pathway capacity and throttle to 1/16 of it, "
+                         "so the operating point tracks the machine instead "
+                         "of a hardcoded rate")
     ap.add_argument("--clocks", type=int, default=6)
     ap.add_argument("--staleness", type=int, default=2)
     ap.add_argument("--priority_frac", type=float, default=0.05)
+    ap.add_argument("--wire_kb", type=int, default=64,
+                    help="dense flush size in KiB for the wire-codec grid "
+                         "arms (smaller than --param_kb: the grid sweeps "
+                         "6 codec x dtype arms over the same link)")
+    ap.add_argument("--wire_clocks", type=int, default=4)
     args = ap.parse_args(argv)
 
     side = int(max(16, (args.param_kb * 256) ** 0.5))  # side^2 f32 = kb
-    rate_bps = args.link_mbps * 1e6 / 8.0
     params = {"fc": {"w": np.zeros((side, side), np.float32)}}
+
+    # ---- wire-codec grid arm: push-dominant cadence, service-side sync -- #
+    # (push() is asynchronous and a 1-worker gate never waits on its own
+    # clock, so only the server's applied clock bounds the throttled
+    # uplink transfer)
+    from poseidon_tpu.proto.wire import (reset_wire_stats, set_wire_codec,
+                                         wire_stats)
+
+    def run_wire_arm(codec_on: bool, wd: str, link_mbps: float,
+                     wire_side: int, clocks: int) -> dict:
+        wparams = {"fc": {"w": np.zeros((wire_side, wire_side),
+                                        np.float32)}}
+        set_wire_codec(codec_on)
+        reset_wire_stats()
+        svc = ParamService(wparams, n_workers=1)
+        proxy = FaultProxy(("127.0.0.1", svc.port))
+        if link_mbps > 0:
+            rate = link_mbps * 1e6 / 8.0
+            # burst far below one frame: transfer time tracks frame bytes
+            proxy.add_rule(FaultRule(action="throttle", rate_bps=rate,
+                                     burst_bytes=8192))
+        cli = AsyncSSPClient(0, proxy.addr, 0, n_workers=1, wire_dtype=wd)
+        rng = np.random.RandomState(23)
+        try:
+            t0 = time.monotonic()
+            for c in range(clocks):
+                cli.push({"fc": {"w": rng.randn(wire_side, wire_side)
+                                 .astype(np.float32) * 1e-3}})
+                cli.gate(c + 1)
+            deadline = time.monotonic() + 120.0
+            while svc.clocks.get(0, -1) < clocks - 1:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("wire arm: pushes not applied")
+                time.sleep(0.0002)
+            wall = time.monotonic() - t0
+            counters = cli.comm_counters()
+            ws = wire_stats()
+        finally:
+            cli.close()
+            proxy.close()
+            svc.close()
+            set_wire_codec(True)
+        logical = clocks * wire_side * wire_side * 4  # f32 update bytes
+        sent = counters["bytes_sent"]
+        saved = counters.get("wire_bytes_saved", 0.0)
+        return {
+            "wall_s": round(wall, 4),
+            "logical_mb": round(logical / 1e6, 3),
+            "bytes_sent": sent,
+            "effective_mbps": round(logical * 8 / wall / 1e6, 3),
+            "wire_compression_ratio": round((sent + saved) / sent, 3)
+            if sent else 1.0,
+            "wire_encode_ms": round(ws["encode_ns"] / 1e6, 3),
+            "wire_decode_ms": round(ws["decode_ns"] / 1e6, 3),
+            "codec_frames": ws["frames_encoded"],
+            "pickle_frames": ws["pickle_frames_sent"],
+            "transfer_ms": round(sent / (link_mbps * 1e6 / 8.0) * 1e3, 3)
+            if link_mbps > 0 else 0.0,
+        }
+
+    # resolve the link: explicit flag, else 1/16 of the measured
+    # unthrottled capacity of the very pathway the arms drive (client
+    # encode -> loopback -> server decode+apply), so the throttle anchors
+    # to the machine, never to a magic constant
+    wire_side = int(max(16, (args.wire_kb * 256) ** 0.5))
+    capacity_mbps = None
+    link_mbps = args.link_mbps
+    if link_mbps <= 0:
+        probe = run_wire_arm(True, "", 0.0, wire_side, args.wire_clocks)
+        capacity_mbps = probe["effective_mbps"]
+        link_mbps = max(1.0, capacity_mbps / 16.0)
+    rate_bps = link_mbps * 1e6 / 8.0
 
     def run_arm(managed: bool) -> dict:
         svc = ParamService(params, n_workers=1)
@@ -2227,7 +2307,7 @@ def comms_main(argv: list | None = None) -> None:
                                  burst_bytes=int(rate_bps / 8)))
         cli = AsyncSSPClient(
             0, proxy.addr, args.staleness, n_workers=1,
-            budget_mbps=args.link_mbps if managed else None,
+            budget_mbps=link_mbps if managed else None,
             priority_frac=args.priority_frac)
         rng = np.random.RandomState(17)
         t0 = time.monotonic()
@@ -2256,7 +2336,9 @@ def comms_main(argv: list | None = None) -> None:
     cfg = {
         "cpu_proxy": True,  # socket tier on loopback; TPU DCN re-measure
         #                     queued for the tunnel (ROADMAP item 4 links)
-        "link_mbps": args.link_mbps,
+        "link_mbps": round(link_mbps, 3),
+        "link_auto": args.link_mbps <= 0,
+        "capacity_mbps": capacity_mbps,
         "param_kb": args.param_kb,
         "clocks": args.clocks,
         "staleness": args.staleness,
@@ -2272,6 +2354,46 @@ def comms_main(argv: list | None = None) -> None:
     emit({"metric": "managed_comm_deferred_fraction",
           "value": round(managed.get("deferred_fraction", 0.0), 4),
           "unit": "fraction", "vs_baseline": round(speedup, 3), **cfg})
+
+    # ---- wire codec x dtype grid over the SAME throttled link ----------- #
+    # every arm pushes the identical f32 update stream; "effective
+    # throughput" is logical f32 bytes delivered per second, so a dtype
+    # arm wins exactly by what compression + codec framing buy on the wire
+    grid = [("pickle", ""), ("pickle", "bf16"), ("codec", ""),
+            ("codec", "bf16"), ("codec", "f16"), ("codec", "int8")]
+    wire = {}
+    for framing, wd in grid:
+        arm = f"{framing}-{wd or 'f32'}"
+        wire[arm] = run_wire_arm(framing == "codec", wd, link_mbps,
+                                 wire_side, args.wire_clocks)
+    wcfg = {"cpu_proxy": True, "link_mbps": round(link_mbps, 3),
+            "link_auto": args.link_mbps <= 0, "capacity_mbps": capacity_mbps,
+            "wire_kb": args.wire_kb, "wire_clocks": args.wire_clocks}
+    base = wire["pickle-f32"]
+    for arm, r in wire.items():
+        ratio = round(r["effective_mbps"] / base["effective_mbps"], 3) \
+            if base["effective_mbps"] else 0.0
+        emit({"metric": "wire_encode_ms", "value": r["wire_encode_ms"],
+              "unit": "ms", "vs_baseline": ratio, "arm": arm, **wcfg})
+        emit({"metric": "wire_decode_ms", "value": r["wire_decode_ms"],
+              "unit": "ms", "vs_baseline": ratio, "arm": arm, **wcfg})
+        emit({"metric": "wire_compression_ratio",
+              "value": r["wire_compression_ratio"], "unit": "x",
+              "vs_baseline": ratio, "arm": arm, **wcfg})
+    # the acceptance pair: codec+bf16 effective throughput over the
+    # pickle/f32 dense path on the same link, and the codec's own
+    # (de)serialization cost as a fraction of throttled transfer time
+    best = wire["codec-bf16"]
+    speed = (best["effective_mbps"] / base["effective_mbps"]
+             if base["effective_mbps"] else 0.0)
+    overhead = ((best["wire_encode_ms"] + best["wire_decode_ms"])
+                / best["transfer_ms"] if best["transfer_ms"] else 0.0)
+    emit({"metric": "wire_codec_speedup", "value": round(speed, 3),
+          "unit": "x", "vs_baseline": round(speed, 3), **wcfg,
+          "arms": wire})
+    emit({"metric": "wire_codec_overhead_fraction",
+          "value": round(overhead, 4), "unit": "fraction",
+          "vs_baseline": round(speed, 3), **wcfg})
 
 
 def fabric_main(argv: list | None = None) -> None:

@@ -165,6 +165,11 @@ class ManagedCommConfig:
     # adaptive cadence: back off payload frequency under congestion
     # (queue depth / bucket deficit), recover as the link drains
     adaptive: bool = False
+    # wire dtype for DCN delta payloads ('' = f32, today's wire byte for
+    # byte; 'bf16'/'f16'/'int8' compress with EXACT error feedback —
+    # quantization error rides the managed-communication residual).
+    # Resolution: --wire_dtype flag > TunedPlan knob > this default.
+    wire_dtype: str = ""
 
 
 _managed_comm = ManagedCommConfig()

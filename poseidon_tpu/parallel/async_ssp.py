@@ -148,10 +148,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..proto.wire import (AuthError, FrameError, client_handshake,
+from ..proto.wire import (WIRE_CODEC_VERSION, AuthError, FrameError,
+                          client_handshake, mark_codec_socket,
                           recv_frame as _recv_msg,
                           recv_frame_sized as _recv_msg_sized,
-                          send_frame as _send_msg, server_handshake)
+                          send_frame as _send_msg, server_handshake,
+                          wire_codec_enabled)
 # span instrumentation for the tier's wait points (push enqueue, anchor
 # pulls, SSP gate, elastic admit); jax-free like everything else here, and
 # a no-op until the engine enables the recorder under --trace_out
@@ -213,17 +215,44 @@ def _is_sparse(v) -> bool:
     return isinstance(v, tuple) and len(v) == 3 and v[0] == "topk"
 
 
+def _is_q8(v) -> bool:
+    """int8 wire leaf: ("q8", per-bucket f32 scale, int8 codes)."""
+    return isinstance(v, tuple) and len(v) == 3 and v[0] == "q8"
+
+
+def _dense_f32(v) -> np.ndarray:
+    """Widen one DENSE wire leaf to float32 — the SAME f32 arithmetic on
+    every participant (client cache rebuild and server apply must agree
+    bitwise): bf16/f16 widen exactly, q8 dequantizes as the deterministic
+    f32 product scale * codes."""
+    if _is_q8(v):
+        _, scale, q = v
+        return np.float32(scale) * q.astype(np.float32)
+    if v.dtype != np.float32:
+        return v.astype(np.float32)
+    return v
+
+
 def _tree_add_any(a: Dict, b: Dict) -> None:
-    """In-place a += b where b's leaves are dense ndarrays OR sparse
-    ("topk", idx, vals) tuples. Top-k indices are unique by construction,
+    """In-place a += b where b's leaves are dense ndarrays (f32 or a
+    compressed wire dtype) OR sparse ("topk", idx, vals) tuples (vals
+    possibly compressed). Top-k indices are unique by construction,
     and ``.flat`` fancy assignment writes through regardless of layout."""
     for l, ps in b.items():
         for p, v in ps.items():
             if _is_sparse(v):
                 _, idx, vals = v
-                a[l][p].flat[idx] += vals
+                a[l][p].flat[idx] += _dense_f32(vals)
             else:
-                a[l][p] += v
+                a[l][p] += _dense_f32(v)
+
+
+def _leaf_copy_any(v):
+    if _is_sparse(v):
+        return ("topk", np.array(v[1]), _leaf_copy_any(v[2]))
+    if _is_q8(v):
+        return ("q8", np.float32(v[1]), np.array(v[2]))
+    return np.array(v)
 
 
 def _tree_copy_any(a: Dict) -> Dict:
@@ -231,8 +260,7 @@ def _tree_copy_any(a: Dict) -> Dict:
     for l, ps in a.items():
         out[l] = {}
         for p, v in ps.items():
-            out[l][p] = (("topk", np.array(v[1]), np.array(v[2]))
-                         if _is_sparse(v) else np.array(v))
+            out[l][p] = _leaf_copy_any(v)
     return out
 
 
@@ -289,6 +317,92 @@ def split_topk(tree: Dict, frac: float):
         residual.setdefault(l, {})[p] = res
         off += n
     return sent, residual, k, n_total
+
+
+# --------------------------------------------------------------------------- #
+# wire-dtype delta compression (error feedback over the codec)
+# --------------------------------------------------------------------------- #
+# The wire dtype shrinks what a flush puts on the link: bf16/f16 leaves
+# travel at half width, int8 at a quarter (per-bucket scale). The
+# quantization ERROR is not lost — it joins the managed-communication
+# residual (PR 12's machinery) so `dequant(sent) + residual == update`
+# holds BITWISE: the residual is computed against the exact f32 value
+# the receiver reconstructs (widening is exact; v - dequant is exact by
+# Sterbenz — the dequantized value is always within a factor of two of
+# v, or v rides the residual whole), and it ships with the next flush.
+# force_full flushes (mark_done/leave/close) stay EXACT f32 so a
+# finished worker's anchor contribution is its whole update stream.
+
+WIRE_DTYPES = ("", "f32", "bf16", "f16", "int8")
+# full-flush wire/f32 size ratio, for the budget's dense-vs-partial
+# estimate (actual bytes are charged from the real frame at send time)
+_WIRE_RATIO = {"": 1.0, "bf16": 0.5, "f16": 0.5, "int8": 0.26}
+
+
+def resolve_wire_dtype(wd) -> str:
+    """Normalize a wire-dtype knob value; '' (and 'f32') mean off."""
+    wd = (wd or "").strip().lower()
+    if wd in ("f32", "float32", "none", "off"):
+        wd = ""
+    if wd not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire_dtype must be one of {WIRE_DTYPES}, got {wd!r}")
+    return wd
+
+
+def _wire_np_dtype(wd: str) -> np.dtype:
+    if wd == "bf16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float16)
+
+
+def _quantize_leaf(v: np.ndarray, wd: str):
+    """Quantize one dense f32 leaf for the wire. Returns
+    ``(wire_leaf, residual_f32, wire_nbytes)`` with the EXACT
+    error-feedback contract ``_dense_f32(wire_leaf) + residual == v``
+    bitwise. Leaves int8 cannot represent usefully (all-zero or
+    non-finite amax) ship as raw f32 with a zero residual."""
+    v = np.asarray(v, np.float32)
+    if wd == "int8":
+        amax = float(np.max(np.abs(v))) if v.size else 0.0
+        if not np.isfinite(amax) or amax == 0.0:
+            return v, np.zeros_like(v), v.nbytes
+        scale = np.float32(amax / 127.0)
+        q = np.clip(np.rint(v / scale), -127, 127).astype(np.int8)
+        back = np.float32(scale) * q.astype(np.float32)
+        return ("q8", scale, q), v - back, q.nbytes + 4
+    with np.errstate(over="ignore"):   # f16 overflow handled below
+        q = v.astype(_wire_np_dtype(wd))
+    back = q.astype(np.float32)
+    # f16 overflow (|v| > 65504 -> inf): those entries ride the residual
+    # whole instead — back becomes 0 there, keeping v - back exact
+    bad = ~np.isfinite(back) & np.isfinite(v)
+    if bad.any():
+        q[bad] = 0
+        back = q.astype(np.float32)
+    return q, v - back, q.nbytes
+
+
+def _quantize_tree(tree: Dict, wd: str):
+    """Quantize every dense leaf of a full flush. Returns
+    ``(wire_tree, residual_tree_or_None, f32_bytes_saved)`` — residual
+    is None when quantization was exact everywhere (e.g. power-of-two
+    deltas under bf16), so no spurious force-full tick rides behind."""
+    wire: Dict = {}
+    residual: Dict = {}
+    saved = 0
+    any_resid = False
+    for l, ps in tree.items():
+        wire[l] = {}
+        residual[l] = {}
+        for p, v in ps.items():
+            wl, res, wn = _quantize_leaf(v, wd)
+            wire[l][p] = wl
+            residual[l][p] = res
+            saved += v.nbytes - wn
+            any_resid = any_resid or bool(np.any(res))
+    return wire, (residual if any_resid else None), saved
 
 
 class TokenBucket:
@@ -619,8 +733,27 @@ class ParamService:
                         self._touch(worker)
                     if kind == "hello":
                         # identification + liveness only; a restarted
-                        # worker resumes its clock/seq via rejoin()'s pull
-                        _send_msg(conn, {"ok": True})
+                        # worker resumes its clock/seq via rejoin()'s pull.
+                        # The reply advertises the binary codec so a new
+                        # client knows negotiation is worth attempting —
+                        # an old client ignores the extra key, an old
+                        # server never advertises, both stay on pickle.
+                        ack = {"ok": True}
+                        if wire_codec_enabled():
+                            ack["codec"] = WIRE_CODEC_VERSION
+                        _send_msg(conn, ack)
+                    elif kind == "wire":
+                        # codec negotiation: affirm iff we speak exactly
+                        # the client's version AND the codec is enabled
+                        # here. The reply itself is still pickle (sent
+                        # before the connection is marked); every later
+                        # frame on this connection rides the codec.
+                        ok = bool(wire_codec_enabled()
+                                  and msg.get("codec") == WIRE_CODEC_VERSION)
+                        _send_msg(conn, {"ok": ok,
+                                         "codec": WIRE_CODEC_VERSION})
+                        if ok:
+                            mark_codec_socket(conn)
                     elif kind == "push":
                         w = msg["worker"]
                         seq = msg.get("seq", msg["clock"])
@@ -820,6 +953,7 @@ class AsyncSSPClient:
                  budget_mbps: Optional[float] = None,
                  priority_frac: float = 0.1,
                  adaptive: bool = False,
+                 wire_dtype: str = "",
                  bucket_clock: Callable[[], float] = time.monotonic,
                  record_events: bool = False):
         self.worker = worker
@@ -846,6 +980,18 @@ class AsyncSSPClient:
             self.budget = None
         self.priority_frac = min(1.0, max(1e-6, priority_frac))
         self.adaptive = adaptive
+        # wire-dtype compression ('' = off, today's f32 wire byte for
+        # byte). Quantization error joins the residual (error feedback),
+        # which adarevision cannot carry — its server rule needs raw
+        # dense gradients, same refusal as the bandwidth budget.
+        self._wire = resolve_wire_dtype(wire_dtype)
+        if self._wire and server_logic == "adarevision":
+            raise ValueError(
+                "wire_dtype compression does not compose with "
+                "server_logic='adarevision': the server's backlog re-base "
+                "needs dense raw-gradient pushes, not error-feedback "
+                "quantized deltas")
+        self.wire_bytes_saved = 0
         self._residual: Optional[Dict] = None  # train-thread only
         # cadence backoff factor (1 = every window ships its delta); the
         # sender thread escalates/decays it, push() reads it — both under
@@ -929,8 +1075,21 @@ class AsyncSSPClient:
                 # and surfaces here as a dead channel (dial retries, then
                 # the rendezvous deadline raises)
                 client_handshake(sk, self.auth_token)
-            _send_msg(sk, {"kind": "hello", "worker": self.worker})
-            _recv_msg(sk)
+            _send_msg(sk, {"kind": "hello", "worker": self.worker},
+                      codec=False)
+            hello = _recv_msg(sk)
+            # codec negotiation (re-run on every reconnect — marking is
+            # per socket): only offered when the hello reply advertised
+            # the same version, so an old service never sees the kind.
+            # The negotiation frames themselves are always pickle.
+            if (wire_codec_enabled() and isinstance(hello, dict)
+                    and hello.get("codec") == WIRE_CODEC_VERSION):
+                _send_msg(sk, {"kind": "wire",
+                               "codec": WIRE_CODEC_VERSION}, codec=False)
+                ack = _recv_msg(sk)
+                if isinstance(ack, dict) and ack.get("ok") \
+                        and ack.get("codec") == WIRE_CODEC_VERSION:
+                    mark_codec_socket(sk)
         except BaseException:
             sk.close()
             raise
@@ -1182,7 +1341,8 @@ class AsyncSSPClient:
         clock contract). Unlimited budget short-circuits to exactly the
         dense path. Caller is the train thread (push); the residual is
         touched only here and in refresh/join, same thread."""
-        if self.budget is None and self._residual is None:
+        if self.budget is None and self._residual is None \
+                and not self._wire:
             # today's dense path, byte for byte (counters only)
             if delta:
                 with self._stats_lock:
@@ -1215,14 +1375,11 @@ class AsyncSSPClient:
                     self.deferred_elems += n
                     self.pushed_elems += n
                 return {}, False
-            if self.budget.available() >= _tree_nbytes(flat):
+            est = _tree_nbytes(flat) * _WIRE_RATIO[self._wire]
+            if self.budget.available() >= est:
                 full = True  # budget comfortable: dense flush
         if full:
-            self._residual = None
-            with self._stats_lock:
-                self.full_pushes += 1
-                self.pushed_elems += n
-            return flat, True
+            return self._full_flush(flat, n, force_full)
         # budget tight: magnitude-prioritized partial push
         sent, residual, k, n = split_topk(flat, self.priority_frac)
         if k >= n:
@@ -1230,17 +1387,48 @@ class AsyncSSPClient:
             # tree so small the 1-entry floor covers it): that is a full
             # flush and must be labeled one — the durable clock advances
             # and no all-zero residual is carried around
-            self._residual = None
-            with self._stats_lock:
-                self.full_pushes += 1
-                self.pushed_elems += n
-            return flat, True
+            return self._full_flush(flat, n, force_full)
+        saved = 0
+        if self._wire:
+            # TOPK values compress too; the quantization error lands in
+            # the residual AT the selected indices (zero there by
+            # split_topk's construction), keeping sent + residual == the
+            # folded update bitwise
+            for l, ps in sent.items():
+                for p, t in ps.items():
+                    _, idx, vals = t
+                    wl, res, wn = _quantize_leaf(vals, self._wire)
+                    if np.any(res):
+                        residual[l][p].flat[idx] = res
+                    ps[p] = ("topk", idx, wl)
+                    saved += vals.nbytes - wn
         self._residual = residual
         with self._stats_lock:
             self.partial_pushes += 1
             self.deferred_elems += n - k
             self.pushed_elems += n
+            self.wire_bytes_saved += saved
         return sent, False
+
+    def _full_flush(self, flat: Dict, n: int,
+                    force_full: bool) -> Tuple[Dict, bool]:
+        """One full (durable) flush of the folded update. Compressed to
+        the wire dtype EXCEPT under force_full — mark_done/leave/close
+        ship exact f32 so a finishing worker leaves no residual behind
+        and its anchor contribution is its whole update stream."""
+        if self._wire and not force_full:
+            payload, self._residual, saved = _quantize_tree(flat,
+                                                            self._wire)
+            with self._stats_lock:
+                self.full_pushes += 1
+                self.pushed_elems += n
+                self.wire_bytes_saved += saved
+            return payload, True
+        self._residual = None
+        with self._stats_lock:
+            self.full_pushes += 1
+            self.pushed_elems += n
+        return flat, True
 
     def push(self, delta: Dict, force_full: bool = False) -> int:
         """Flush one clock's accumulated update. Returns the new clock.
@@ -1588,6 +1776,9 @@ class AsyncSSPClient:
                 "cadence_backoffs": float(self.cadence_backoffs),
                 "partial_pushes": float(self.partial_pushes),
                 "full_pushes": float(self.full_pushes),
+                # f32 bytes the wire dtype kept OFF the link (0 with
+                # compression off) — the [comm] line and stats.yaml gauge
+                "wire_bytes_saved": float(self.wire_bytes_saved),
             }
         return out
 

@@ -17,6 +17,9 @@ import io
 import pickle
 import socket
 import struct
+import threading
+import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -105,12 +108,376 @@ def set_max_frame_bytes(n: Optional[int]) -> None:
     _max_frame_override = n
 
 
-def send_frame(sock: socket.socket, obj) -> int:
+# --------------------------------------------------------------------------- #
+# Zero-copy binary tensor codec (wire codec v1). A codec payload is
+#
+#   CODEC_MAGIC(4) | u32 skeleton_len | skeleton | raw tensor buffers
+#
+# The skeleton is a tiny pickle-free tag encoding of the message tree
+# (dicts/lists/tuples/scalars); every ndarray leaf is replaced by a
+# dtype-name + shape reference, and the array BYTES travel after the
+# skeleton, concatenated in reference order — offsets are implied by the
+# cumulative dtype/shape sizes, so there is no offset table to trust.
+# Send is scatter-gather (``sendmsg`` over memoryviews of the live
+# arrays — no serialization copy); receive fills ONE preallocated
+# buffer sized by the cap-checked length prefix, and decoded arrays are
+# ``np.frombuffer`` views into it (zero-copy; the buffer lives as long
+# as any view). CODEC_MAGIC cannot collide with a pickle payload (those
+# start with b"\x80"), so a receiver auto-detects the codec per frame
+# and old-peer pickle frames keep working unchanged. Whether a SENDER
+# may use the codec is negotiated per connection (the "wire" message
+# kind in the async-SSP and serving tiers) and recorded here in a
+# process-wide WeakSet of sockets. Byte order is native little-endian
+# on both ends (the x86/TPU-host fleet; the skeleton itself is
+# endian-explicit).
+# --------------------------------------------------------------------------- #
+
+CODEC_MAGIC = b"PTC\x01"        # version baked into the 4th byte
+WIRE_CODEC_VERSION = 1
+WIRE_CODEC_ENV = "POSEIDON_WIRE_CODEC"
+_codec_override: Optional[bool] = None
+# sockets whose PEER affirmed the codec during negotiation; WeakSet so a
+# closed socket's entry dies with it (no unbounded registry growth)
+_codec_socks: "weakref.WeakSet" = weakref.WeakSet()
+
+_wire_stats_lock = threading.Lock()
+_wire_stats = {
+    "frames_encoded": 0, "encode_ns": 0, "encoded_bytes": 0,
+    "frames_decoded": 0, "decode_ns": 0, "decoded_bytes": 0,
+    "pickle_frames_sent": 0, "pickle_frames_recv": 0,
+}
+
+
+def wire_stats() -> Dict[str, int]:
+    """Process-wide codec telemetry (encode/decode time and bytes) for
+    ``bench.py comms``'s ``wire_encode_ms``/``wire_decode_ms`` lines.
+    Timers cover ONLY (de)serialization — socket time is excluded, so
+    the numbers compare against link transfer time directly."""
+    with _wire_stats_lock:
+        return dict(_wire_stats)
+
+
+def reset_wire_stats() -> None:
+    with _wire_stats_lock:
+        for k in _wire_stats:
+            _wire_stats[k] = 0
+
+
+def wire_codec_enabled() -> bool:
+    """Codec kill-switch: explicit :func:`set_wire_codec` wins, then the
+    ``POSEIDON_WIRE_CODEC`` env, then ON. Off means negotiation is never
+    offered/accepted and every frame is byte-for-byte the pickle wire."""
+    if _codec_override is not None:
+        return _codec_override
+    import os
+    env = os.environ.get(WIRE_CODEC_ENV)
+    if env is not None:
+        return env.strip().lower() not in ("0", "off", "false", "no", "")
+    return True
+
+
+def set_wire_codec(on: Optional[bool]) -> None:
+    """Process-wide codec override (None restores env/default)."""
+    global _codec_override
+    _codec_override = on
+
+
+def mark_codec_socket(sock: socket.socket) -> None:
+    """Record that the peer on ``sock`` negotiated wire codec v1 — from
+    here on :func:`send_frame` encodes this socket's frames binary."""
+    _codec_socks.add(sock)
+
+
+def socket_uses_codec(sock: socket.socket) -> bool:
+    return sock in _codec_socks
+
+
+class _CodecUnsupported(Exception):
+    """Message contains something the skeleton cannot carry — the frame
+    falls back to whole-message pickle (auto-detected by the receiver)."""
+
+
+_dtype_name_cache: Dict[str, Optional[np.dtype]] = {}
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """Resolve a wire dtype NAME (names, not ``.str``, because extension
+    dtypes like bfloat16 all stringify as ``<V2``)."""
+    dt = _dtype_name_cache.get(name)
+    if dt is None:
+        try:
+            dt = np.dtype(name)
+        except TypeError:
+            # extension dtypes register their names only once their
+            # package is imported (ml_dtypes for the bf16 wire)
+            import ml_dtypes  # noqa: F401
+            dt = np.dtype(name)
+        _dtype_name_cache[name] = dt
+    return dt
+
+
+def _dtype_wire_ok(dt: np.dtype) -> bool:
+    """A dtype rides the codec iff its NAME round-trips to itself."""
+    ok = _dtype_name_cache.get("ok:" + dt.name)
+    if ok is None:
+        try:
+            ok = (not dt.hasobject) and _dtype_from_name(dt.name) == dt
+        except Exception:  # noqa: BLE001 — unknown name → pickle fallback
+            ok = False
+        _dtype_name_cache["ok:" + dt.name] = ok  # type: ignore[assignment]
+    return bool(ok)
+
+
+_MAX_SKELETON_DEPTH = 64
+
+
+def _enc_skeleton(obj, out: bytearray, arrays: List[np.ndarray],
+                  depth: int) -> None:
+    if depth > _MAX_SKELETON_DEPTH:
+        raise _CodecUnsupported("nesting too deep")
+    if obj is None:
+        out += b"N"
+    elif obj is True:
+        out += b"T"
+    elif obj is False:
+        out += b"F"
+    elif type(obj) is int:
+        try:
+            out += b"i" + struct.pack("!q", obj)
+        except struct.error:
+            raise _CodecUnsupported("int out of i64 range") from None
+    elif type(obj) is float:
+        out += b"f" + struct.pack("!d", obj)
+    elif type(obj) is str:
+        raw = obj.encode("utf-8")
+        out += b"s" + struct.pack("!I", len(raw))
+        out += raw
+    elif type(obj) is bytes:
+        out += b"y" + struct.pack("!I", len(obj))
+        out += obj
+    elif isinstance(obj, np.ndarray):
+        if not _dtype_wire_ok(obj.dtype) or obj.ndim > 255:
+            raise _CodecUnsupported(f"array dtype {obj.dtype}")
+        nm = obj.dtype.name.encode("ascii")
+        out += b"a" + struct.pack("!B", len(nm)) + nm
+        out += struct.pack("!B", obj.ndim)
+        for d in obj.shape:
+            out += struct.pack("!Q", d)
+        arrays.append(obj)
+    elif isinstance(obj, np.generic):
+        dt = np.asarray(obj).dtype
+        if not _dtype_wire_ok(dt):
+            raise _CodecUnsupported(f"scalar dtype {dt}")
+        nm = dt.name.encode("ascii")
+        raw = obj.tobytes()
+        out += b"z" + struct.pack("!B", len(nm)) + nm
+        out += struct.pack("!B", len(raw))
+        out += raw
+    elif type(obj) in (list, tuple):
+        out += (b"l" if type(obj) is list else b"t")
+        out += struct.pack("!I", len(obj))
+        for item in obj:
+            _enc_skeleton(item, out, arrays, depth + 1)
+    elif type(obj) is dict:
+        out += b"d" + struct.pack("!I", len(obj))
+        for k, v in obj.items():
+            _enc_skeleton(k, out, arrays, depth + 1)
+            _enc_skeleton(v, out, arrays, depth + 1)
+    else:
+        raise _CodecUnsupported(type(obj).__name__)
+
+
+def _array_wire_view(arr: np.ndarray) -> memoryview:
+    """A zero-copy byte view of the array's buffer. Extension dtypes
+    (bfloat16) refuse the buffer protocol directly, so view through
+    uint8; a non-contiguous leaf costs one compaction copy here."""
+    arr = np.ascontiguousarray(arr)
+    return memoryview(arr.reshape(-1).view(np.uint8))
+
+
+def encode_codec_payload(obj):
+    """Encode ``obj`` as a codec payload. Returns ``(parts, nbytes)`` —
+    ``parts`` is a scatter-gather list (header bytes + live array
+    views, NO concatenation copy) — or None when the message holds
+    something the skeleton cannot carry (caller falls back to pickle)."""
+    out = bytearray()
+    arrays: List[np.ndarray] = []
+    try:
+        _enc_skeleton(obj, out, arrays, 0)
+    except _CodecUnsupported:
+        return None
+    if len(out) > 0xFFFFFFFF:
+        return None
+    parts: List = [CODEC_MAGIC + struct.pack("!I", len(out)) + bytes(out)]
+    total = len(parts[0])
+    for arr in arrays:
+        mv = _array_wire_view(arr)
+        parts.append(mv)
+        total += len(mv)
+    return parts, total
+
+
+class _DecCursor:
+    """Bounds-checked cursors over one received payload: ``pos`` walks
+    the skeleton, ``data`` walks the trailing tensor region. Every read
+    is length-checked BEFORE it happens — a truncated or lying skeleton
+    raises FrameError instead of reading a neighbour's bytes."""
+
+    __slots__ = ("mv", "pos", "skel_end", "data", "end")
+
+    def __init__(self, mv: memoryview, skel_end: int):
+        self.mv = mv
+        self.pos = 8
+        self.skel_end = skel_end
+        self.data = skel_end
+        self.end = len(mv)
+
+    def take(self, n: int) -> memoryview:
+        if self.pos + n > self.skel_end:
+            raise FrameError("codec skeleton truncated")
+        v = self.mv[self.pos:self.pos + n]
+        self.pos += n
+        return v
+
+    def take_data(self, n: int) -> memoryview:
+        if self.data + n > self.end:
+            raise FrameError("codec tensor data truncated")
+        v = self.mv[self.data:self.data + n]
+        self.data += n
+        return v
+
+
+def _dec_skeleton(cur: _DecCursor, depth: int):
+    if depth > _MAX_SKELETON_DEPTH:
+        raise FrameError("codec skeleton too deep")
+    tag = bytes(cur.take(1))
+    if tag == b"N":
+        return None
+    if tag == b"T":
+        return True
+    if tag == b"F":
+        return False
+    if tag == b"i":
+        return struct.unpack("!q", cur.take(8))[0]
+    if tag == b"f":
+        return struct.unpack("!d", cur.take(8))[0]
+    if tag == b"s":
+        (n,) = struct.unpack("!I", cur.take(4))
+        return bytes(cur.take(n)).decode("utf-8")
+    if tag == b"y":
+        (n,) = struct.unpack("!I", cur.take(4))
+        return bytes(cur.take(n))
+    if tag == b"a":
+        (nml,) = struct.unpack("!B", cur.take(1))
+        dt = _dtype_from_name(bytes(cur.take(nml)).decode("ascii"))
+        (nd,) = struct.unpack("!B", cur.take(1))
+        shape = tuple(struct.unpack("!Q", cur.take(8))[0]
+                      for _ in range(nd))
+        count = 1
+        for d in shape:
+            count *= d
+        raw = cur.take_data(count * dt.itemsize)
+        # zero-copy: the array is a view into the receive buffer
+        # (writable — the buffer is a per-frame bytearray, never reused)
+        return np.frombuffer(raw, dtype=dt).reshape(shape)
+    if tag == b"z":
+        (nml,) = struct.unpack("!B", cur.take(1))
+        dt = _dtype_from_name(bytes(cur.take(nml)).decode("ascii"))
+        (n,) = struct.unpack("!B", cur.take(1))
+        return np.frombuffer(bytes(cur.take(n)), dtype=dt)[0]
+    if tag in (b"l", b"t"):
+        (n,) = struct.unpack("!I", cur.take(4))
+        items = [_dec_skeleton(cur, depth + 1) for _ in range(n)]
+        return items if tag == b"l" else tuple(items)
+    if tag == b"d":
+        (n,) = struct.unpack("!I", cur.take(4))
+        return {_dec_skeleton(cur, depth + 1): _dec_skeleton(cur, depth + 1)
+                for _ in range(n)}
+    raise FrameError(f"unknown codec skeleton tag {tag!r}")
+
+
+def decode_codec_payload(buf) -> object:
+    """Decode one codec payload (the receive buffer INCLUDING the magic).
+    Rejects any mismatch between the skeleton's claimed tensor extents
+    and the actual payload size — truncated AND oversized frames both
+    raise FrameError, nothing is silently padded or dropped."""
+    mv = memoryview(buf)
+    if len(mv) < 8 or bytes(mv[:4]) != CODEC_MAGIC:
+        raise FrameError("not a codec payload")
+    (skel_len,) = struct.unpack("!I", mv[4:8])
+    if 8 + skel_len > len(mv):
+        raise FrameError("codec skeleton overruns frame")
+    cur = _DecCursor(mv, 8 + skel_len)
+    try:
+        obj = _dec_skeleton(cur, 0)
+    except FrameError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any malformed skeleton
+        raise FrameError(
+            f"bad codec skeleton: {type(e).__name__}: {e}") from e
+    if cur.pos != cur.skel_end:
+        raise FrameError("codec skeleton has trailing bytes")
+    if cur.data != cur.end:
+        raise FrameError(
+            f"codec frame size mismatch: skeleton consumed "
+            f"{cur.data - cur.skel_end} tensor bytes of "
+            f"{cur.end - cur.skel_end} in the frame")
+    return obj
+
+
+_SENDMSG_BATCH = 64  # stay far under IOV_MAX for one sendmsg call
+
+
+def _sendmsg_all(sock: socket.socket, parts: List) -> None:
+    """sendall() for a scatter-gather buffer list: loop ``sendmsg`` over
+    ≤64-buffer batches, resuming cleanly after partial sends."""
+    bufs = [p if isinstance(p, memoryview) else memoryview(p)
+            for p in parts]
+    if not hasattr(sock, "sendmsg"):  # exotic socket-likes: plain sends
+        for b in bufs:
+            sock.sendall(b)
+        return
+    while bufs:
+        n = sock.sendmsg(bufs[:_SENDMSG_BATCH])
+        while bufs and n >= len(bufs[0]):
+            n -= len(bufs[0])
+            bufs.pop(0)
+        if bufs and n:
+            bufs[0] = bufs[0][n:]
+
+
+def send_frame(sock: socket.socket, obj, codec: Optional[bool] = None) -> int:
     """Send one frame; returns the ACTUAL wire bytes (header + payload) so
     bandwidth-budgeted callers (the managed-communication token bucket) can
     account what the link really carried, not an estimate. Refuses frames
     over the configured cap LOUDLY — the peer would drop the connection
-    at its own cap check, and a send-side error names the knob."""
+    at its own cap check, and a send-side error names the knob.
+
+    ``codec=None`` resolves per socket (set during the "wire" negotiation);
+    a codec frame is the zero-copy binary tensor encoding, anything else —
+    codec off, un-negotiated peer, or a message the skeleton cannot carry —
+    is today's pickle wire, byte for byte."""
+    if codec is None:
+        codec = socket_uses_codec(sock)
+    if codec and wire_codec_enabled():
+        t0 = time.perf_counter_ns()
+        enc = encode_codec_payload(obj)
+        dt = time.perf_counter_ns() - t0
+        if enc is not None:
+            parts, n = enc
+            cap = max_frame_bytes()
+            if n > cap:
+                raise FrameTooLargeError(
+                    f"refusing to send a {n}-byte frame over the "
+                    f"{cap}-byte cap (raise {MAX_FRAME_ENV} or "
+                    f"set_max_frame_bytes on BOTH ends for frames this "
+                    f"large)")
+            _sendmsg_all(sock, [struct.pack("!Q", n)] + parts)
+            with _wire_stats_lock:
+                _wire_stats["frames_encoded"] += 1
+                _wire_stats["encode_ns"] += dt
+                _wire_stats["encoded_bytes"] += n
+            return n + 8
     buf = io.BytesIO()
     pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
     data = buf.getvalue()
@@ -121,6 +488,8 @@ def send_frame(sock: socket.socket, obj) -> int:
             f"{cap}-byte cap (raise {MAX_FRAME_ENV} or "
             f"set_max_frame_bytes on BOTH ends for frames this large)")
     sock.sendall(struct.pack("!Q", len(data)) + data)
+    with _wire_stats_lock:
+        _wire_stats["pickle_frames_sent"] += 1
     return len(data) + 8
 
 
@@ -138,10 +507,26 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def _recv_into_exact(sock: socket.socket, buf: bytearray) -> None:
+    """Fill the whole preallocated buffer (the codec's single receive
+    allocation — decoded arrays alias it, so it is fresh per frame)."""
+    view = memoryview(buf)
+    n = len(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], min(n - got, 1 << 20))
+        if r == 0:
+            raise FrameError(f"mid-message EOF in payload ({got}/{n} bytes)")
+        got += r
+
+
 def recv_frame_sized(sock: socket.socket):
     """Receive one frame; returns (obj, wire_bytes) — wire_bytes is the
     actual header + payload byte count, the pull-path input to the managed-
-    communication bandwidth accounting."""
+    communication bandwidth accounting. The payload buffer is allocated
+    ONCE, sized by the cap-checked length prefix; codec frames are
+    auto-detected by magic (pickle cannot start with it), so a receiver
+    needs no negotiation state and old-peer pickle frames always work."""
     (n,) = struct.unpack("!Q", recv_exact(sock, 8))
     cap = max_frame_bytes()
     if n > cap:
@@ -151,17 +536,29 @@ def recv_frame_sized(sock: socket.socket):
             f"frame length {n} exceeds cap {cap} (garbage header, or a "
             f"legitimately huge frame — raise {MAX_FRAME_ENV} on both "
             f"ends if it is the latter)")
+    payload = bytearray(n)
     try:
-        payload = recv_exact(sock, n)
+        _recv_into_exact(sock, payload)
     except FrameError:
         raise
     except ConnectionError as e:
         # header arrived, payload did not: mid-message, not a clean close
         raise FrameError(f"mid-message EOF in payload ({e})") from e
+    if n >= len(CODEC_MAGIC) and payload[:4] == CODEC_MAGIC:
+        t0 = time.perf_counter_ns()
+        obj = decode_codec_payload(payload)
+        with _wire_stats_lock:
+            _wire_stats["frames_decoded"] += 1
+            _wire_stats["decode_ns"] += time.perf_counter_ns() - t0
+            _wire_stats["decoded_bytes"] += n
+        return obj, n + 8
     try:
-        return pickle.loads(payload), n + 8
+        obj = pickle.loads(bytes(payload))
     except Exception as e:  # noqa: BLE001 — any undecodable payload
         raise FrameError(f"bad frame payload: {type(e).__name__}: {e}") from e
+    with _wire_stats_lock:
+        _wire_stats["pickle_frames_recv"] += 1
+    return obj, n + 8
 
 
 def recv_frame(sock: socket.socket):

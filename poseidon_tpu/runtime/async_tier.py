@@ -50,7 +50,8 @@ class AsyncSSPTier:
                  first_gate_timeout_s: Optional[float] = None,
                  comm_budget_mbps: Optional[float] = None,
                  comm_priority_frac: Optional[float] = None,
-                 comm_adaptive: Optional[bool] = None):
+                 comm_adaptive: Optional[bool] = None,
+                 comm_wire_dtype: Optional[str] = None):
         self.rank, self.n_procs, coord = self._identity()
         self.staleness = staleness
         self.sync_every = max(1, sync_every)
@@ -65,6 +66,8 @@ class AsyncSSPTier:
                                    else comm_priority_frac)
         self.comm_adaptive = (mc.adaptive if comm_adaptive is None
                               else comm_adaptive)
+        self.comm_wire_dtype = (mc.wire_dtype if comm_wire_dtype is None
+                                else comm_wire_dtype)
         # SSP gate backstop, configurable from the launcher (the client's
         # hardcoded 120 s default killed healthy runs). The FIRST clock's
         # gate waits on peers that are still JIT-compiling their train
@@ -103,7 +106,8 @@ class AsyncSSPTier:
             budget_mbps=(self.comm_budget_mbps
                          if self.comm_budget_mbps > 0 else None),
             priority_frac=self.comm_priority_frac,
-            adaptive=self.comm_adaptive)
+            adaptive=self.comm_adaptive,
+            wire_dtype=self.comm_wire_dtype)
         # ONE join path for every process biography (join() == the admit
         # RPC, idempotent for existing members):
         # - fresh launch-roster worker: admit is a no-op pull, clock -1;
@@ -140,6 +144,8 @@ class AsyncSSPTier:
                    f"(priority_frac {self.comm_priority_frac:g}, "
                    f"adaptive {'on' if self.comm_adaptive else 'off'})"
                    if self.comm_budget_mbps > 0 else "")
+        if self.comm_wire_dtype:
+            managed += f", wire dtype {self.comm_wire_dtype}"
         log(f"async-SSP tier: {len(self._members)} members, staleness "
             f"{staleness}, flush every {self.sync_every} iter(s), service "
             f"{host}:{port}{managed}", rank=self.rank)

@@ -44,10 +44,21 @@ def _engine_from_args(args, phase_nets=True):
     # cmd_train already replaced these with plan/default values; a direct
     # _engine_from_args caller (tests) gets the built-in defaults
     arena_mb = getattr(args, "arena_bucket_mb", None)
+    # --wire_dtype rides TWO tiers: the compiled collectives (CommConfig,
+    # bf16/f16 only) and the managed DCN payload codec (async tier, which
+    # also takes int8). int8 never enters the compiled config — the local
+    # mesh stays at gradient dtype while the DCN frames compress.
+    wd_flag = getattr(args, "wire_dtype", None) or None
+    if wd_flag == "int8":
+        if not getattr(args, "async_ssp", False):
+            raise SystemExit(
+                "--wire_dtype int8 is a managed-tier (async DCN) wire "
+                "format; compiled collectives take bf16/f16")
+        wd_flag = None
     comm = CommConfig(default_strategy=args.strategy,
                       reduce=args.grad_reduce,
                       topk_policy=getattr(args, "topk_policy", "magnitude"),
-                      wire_dtype=getattr(args, "wire_dtype", None) or None,
+                      wire_dtype=wd_flag,
                       topk_block=getattr(args, "topk_block", 0) or None,
                       dwbp_bucket_mb=(
                           None if getattr(args, "dwbp_bucket_mb", -1.0) < 0
@@ -117,6 +128,14 @@ def _engine_from_args(args, phase_nets=True):
             async_cfg["comm_priority_frac"] = v
         if getattr(args, "comm_adaptive", False):
             async_cfg["comm_adaptive"] = True
+        # wire dtype resolution, flag > TunedPlan > default: an explicit
+        # flag rides here (overriding the ManagedCommConfig the TunedPlan
+        # resolution installed); args.wire_dtype itself is NEVER mutated,
+        # so a plan-resolved dtype cannot leak into the compiled-tier
+        # CommConfig above
+        wd = getattr(args, "wire_dtype", "") or ""
+        if wd:
+            async_cfg["comm_wire_dtype"] = wd
         # two-tier fabric: this process leads an SPMD slice and the DCN
         # worker identity is the slice id (runtime/async_tier.FabricTier;
         # needs the POSEIDON_SLICE_ID/POSEIDON_SLICE_SIZE env contract)
@@ -190,6 +209,8 @@ def _apply_tuned_plan_train(args) -> None:
         explicit["max_in_flight"] = args.max_in_flight
     if getattr(args, "steps_per_dispatch", None) is not None:
         explicit["steps_per_dispatch"] = args.steps_per_dispatch
+    if getattr(args, "wire_dtype", ""):
+        explicit["wire_dtype"] = args.wire_dtype
 
     doc, store = None, ""
     if getattr(args, "tuned_plan", "auto") != "off":
@@ -1092,10 +1113,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="which entries the TOPK budget sends (the server's "
                         "UpdateSortPolicy)")
     t.add_argument("--wire_dtype", default="",
-                   choices=["", "f32", "bf16", "f16"],
+                   choices=["", "f32", "bf16", "f16", "int8"],
                    help="reduced-precision gradient exchange: cast grads to "
                         "this dtype for every collective (DenseRowFloat16 "
-                        "analog); empty = exchange at gradient dtype")
+                        "analog); with --async_ssp it also compresses the "
+                        "managed DCN delta frames with exact error feedback "
+                        "(int8 is DCN-only); empty = exchange at gradient "
+                        "dtype (flag > TunedPlan knob > f32 default)")
     t.add_argument("--topk_block", type=int, default=0,
                    help="blocked top-k selection: pick top-k within blocks "
                         "of this many elements instead of one global sort "

@@ -241,7 +241,9 @@ def format_comm(counters: Dict[str, float]) -> str:
     """One display line next to ``[membership]``:
     ``bytes_sent = 1.2 MB, deferred_fraction = 0.31, ...``."""
     def fmt(k: str, v: float) -> str:
-        if k.startswith("bytes"):
+        # byte gauges (bytes_sent/bytes_recv/wire_bytes_saved) scale to
+        # kB/MB; everything else is a fraction, rate or count
+        if k.startswith("bytes") or k == "wire_bytes_saved":
             if v >= 1e6:
                 return f"{k} = {v / 1e6:.1f} MB"
             return f"{k} = {v / 1e3:.1f} kB"

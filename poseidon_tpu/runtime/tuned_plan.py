@@ -82,9 +82,13 @@ BUILTIN_DEFAULTS: Dict[str, Any] = {
     "llm_decode_rungs": "1,2,4,8",
     "llm_prompt_buckets": "16,64,256",
     "llm_replicas_tp": "",        # "RxT" replica×tp factorization; "" = auto
+    # managed DCN delta wire dtype ('' = f32 byte-for-byte; bf16/f16/int8
+    # compress with exact error feedback riding the comm residual)
+    "wire_dtype": "",
 }
 TRAIN_KNOBS = ("conv_layout", "conv_strategy", "arena_bucket_mb", "mesh",
-               "device_prefetch", "max_in_flight", "steps_per_dispatch")
+               "device_prefetch", "max_in_flight", "steps_per_dispatch",
+               "wire_dtype")
 
 
 # --------------------------------------------------------------------------- #
@@ -270,6 +274,11 @@ def apply_training_resolution(res: PlanResolution) -> Dict[str, Any]:
         config.set_policy(conv_strategy=v["conv_strategy"])
     config.set_pipeline_config(device_prefetch=int(v["device_prefetch"]),
                                max_in_flight=int(v["max_in_flight"]))
+    # the managed DCN tier reads its wire dtype from ManagedCommConfig
+    # (async_tier falls back to it when no explicit flag rode async_cfg);
+    # NEVER returned to the caller — the compiled-tier CommConfig takes
+    # the flag only, a plan value must not leak into compiled collectives
+    config.set_managed_comm_config(wire_dtype=str(v.get("wire_dtype", "")))
     mesh = v["mesh"]
     if mesh and res.sources.get("mesh") == "plan":
         # plans are keyed by n_devices so this should never fire, but a
@@ -397,6 +406,9 @@ def search_space(smoke: bool, n_devices: int) -> Dict[str, List]:
         "llm_decode_rungs": (["1,2,4,8", "1,4"] if smoke
                              else ["1,2,4,8", "1,4,8", "1,2,4,8,16"]),
         "llm_replicas_tp": _llm_factorizations(n_devices, smoke),
+        # managed DCN wire dtype, measured over a throttled loopback link
+        # (the f32 default is always a candidate — revert-if-losing)
+        "wire_dtype": ["", "bf16"] if smoke else ["", "bf16", "f16", "int8"],
     }
 
 
@@ -701,6 +713,68 @@ def _measure_llm_knob(arm_specs: Dict[str, Tuple[int, str, int, int]],
     return {name: round(raw[name] / per_tok, 4) for name in raw}
 
 
+def _measure_wire_knob(candidates: List[str], windows: int, iters: int,
+                       link_mbps: float = 8.0, side: int = 96,
+                       clocks: int = 4, staleness: int = 0
+                       ) -> Dict[str, float]:
+    """Wall time of a fixed push/gate/refresh cadence per wire dtype over
+    a THROTTLED loopback link (FaultProxy token bucket) — the operating
+    point where wire compression pays its encode cost back. Each arm
+    drives its own ParamService through its own throttled proxy with an
+    :class:`AsyncSSPClient` configured for that dtype; interleaved
+    windows + min-of-k as everywhere else. The sync point is the SERVICE
+    side (poll the applied clock until every push landed): push() is
+    asynchronous and a 1-worker gate never waits on its own clock, so
+    only server-side apply bounds the throttled uplink transfer. The ''
+    (f32, byte-for-byte) default is always a candidate, so a winner can
+    never measure worse than the exact path it replaces."""
+    import numpy as np
+
+    from ..parallel.async_ssp import AsyncSSPClient, ParamService
+    from .faults import FaultProxy, FaultRule
+
+    rate_bps = link_mbps * 1e6 / 8.0
+    params = {"fc": {"w": np.zeros((side, side), np.float32)}}
+    arms: Dict[str, Callable] = {}
+    closers = []
+    for wd in candidates:
+        svc = ParamService(params, n_workers=1)
+        proxy = FaultProxy(("127.0.0.1", svc.port))
+        # burst far below one frame, so transfer time tracks frame bytes
+        proxy.add_rule(FaultRule(action="throttle", rate_bps=rate_bps,
+                                 burst_bytes=8192))
+        # no bandwidth budget: every push is a FULL flush (still wire-
+        # compressed), so the arm measures the dtype's byte savings over
+        # the throttled link, not the budget scheduler's deferral policy
+        cli = AsyncSSPClient(0, proxy.addr, staleness, n_workers=1,
+                             wire_dtype=wd)
+        closers.append((cli, proxy, svc))
+        rng = np.random.RandomState(11)
+        state = {"clock": 0}
+
+        def run(cli=cli, svc=svc, rng=rng, state=state):
+            for _ in range(clocks):
+                state["clock"] += 1
+                cli.push({"fc": {"w": rng.randn(side, side)
+                                 .astype(np.float32) * 1e-3}})
+                cli.gate(state["clock"])
+            deadline = time.monotonic() + 60.0
+            while svc.clocks.get(0, -1) < state["clock"] - 1:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("wire-knob arm: pushes not applied")
+                time.sleep(0.001)
+
+        arms[wd or "f32"] = run
+    try:
+        return interleaved_min_ms(arms, windows=windows, iters=iters,
+                                  warmup=1)
+    finally:
+        for cli, proxy, svc in closers:
+            cli.close()
+            proxy.close()
+            svc.close()
+
+
 def _conv_strategy_rows(net_param, shapes, conv_layout: str,
                         cache_dir: str) -> Dict[str, Dict]:
     """Run the PR-11 per-layer conv tuner for this model (persisting the
@@ -900,6 +974,18 @@ def run_tune(model: str, *, smoke: bool = False, force: bool = False,
              serve_buckets,
              "measured" + ("" if deploy else " (synthetic probe net)"))
 
+    # ---- managed DCN wire dtype ----------------------------------------- #
+    wire_dtype = str(BUILTIN_DEFAULTS["wire_dtype"])
+    if "wire_dtype" not in skipped:
+        cands = space["wire_dtype"]
+        timings = _measure_wire_knob(cands, windows, iters)
+        winner_s = min(timings, key=timings.get)
+        wire_dtype = next(c for c in cands if (c or "f32") == winner_s)
+        note("wire_dtype", [c or "f32" for c in cands], timings,
+             wire_dtype or "f32",
+             "measured (throttled loopback; f32 default always a "
+             "candidate)")
+
     # ---- LLM serving: page size, rung ladder, replica x tp --------------- #
     # greedy coordinate descent at the deep-overload operating point (the
     # saturated end of the offered-load curve bench.py serving_llm sweeps);
@@ -960,6 +1046,7 @@ def run_tune(model: str, *, smoke: bool = False, force: bool = False,
             # is workload data the probe net cannot stand in for)
             "llm_prompt_buckets": str(BUILTIN_DEFAULTS["llm_prompt_buckets"]),
             "llm_replicas_tp": llm_rt,
+            "wire_dtype": wire_dtype,
         },
         "trials": trials,
         "ab": ab,

@@ -21,7 +21,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..proto.wire import recv_frame, send_frame
+from ..proto.wire import (WIRE_CODEC_VERSION, mark_codec_socket, recv_frame,
+                          send_frame, wire_codec_enabled)
 from ..runtime.metrics import LatencyWindow
 from ..runtime.retry import retry_with_backoff
 
@@ -58,6 +59,21 @@ class ServingClient:
 
     def _dial(self) -> socket.socket:
         sk = socket.create_connection(self.addr, timeout=5.0)
+        # binary tensor codec negotiation (re-run per dial — marking is
+        # per socket). The offer itself is pickle; an old server answers
+        # {"ok": False} through its unknown-kind path and this client
+        # simply stays on the pickle wire — same frames as today.
+        if wire_codec_enabled():
+            try:
+                send_frame(sk, {"kind": "wire",
+                                "codec": WIRE_CODEC_VERSION}, codec=False)
+                ack = recv_frame(sk)
+                if isinstance(ack, dict) and ack.get("ok") \
+                        and ack.get("codec") == WIRE_CODEC_VERSION:
+                    mark_codec_socket(sk)
+            except BaseException:
+                sk.close()
+                raise
         sk.settimeout(None)   # established: block (slow != dead)
         return sk
 
@@ -110,7 +126,9 @@ class ServingClient:
                 if isinstance(reply, dict) and \
                         reply.get("kind") == "gen_chunk":
                     try:
-                        on_tokens(list(reply["tokens"]))
+                        # chunks may arrive as int32 buffers (codec wire)
+                        # or lists (old servers) — callers always see ints
+                        on_tokens([int(t) for t in reply["tokens"]])
                     except Exception:  # noqa: BLE001 — a broken sink must
                         pass           # not kill the stream consumption
                     continue

@@ -536,7 +536,10 @@ class ContinuousScheduler:
         ValueError on malformed inputs — the DynamicBatcher contract."""
         t0 = time.monotonic()
         self.validate_request(inputs)
-        prompt = np.asarray(inputs["prompt"], np.int32)
+        # copy, not asarray: a codec-decoded prompt is a zero-copy VIEW
+        # into its receive buffer, and a queued sequence would pin that
+        # whole frame for its lifetime — detach it at admission
+        prompt = np.array(inputs["prompt"], np.int32)
         max_new = int(inputs.get("max_new", self.executor.default_max_new))
         eos_id = inputs.get("eos_id")
         eos_id = None if eos_id is None else int(eos_id)

@@ -43,7 +43,10 @@ import threading
 import time
 from typing import Dict, Optional
 
-from ..proto.wire import FrameError, recv_frame, send_frame
+import numpy as np
+
+from ..proto.wire import (WIRE_CODEC_VERSION, FrameError, mark_codec_socket,
+                          recv_frame, send_frame, wire_codec_enabled)
 from ..runtime.metrics import StatsRegistry, log
 from .batcher import DeadlineError, DynamicBatcher, ShedError
 
@@ -193,6 +196,18 @@ class InferenceServer:
 
     def _dispatch(self, msg: Dict, conn=None) -> Optional[Dict]:
         kind = msg["kind"]
+        if kind == "wire":
+            # binary tensor codec negotiation: affirm iff the client
+            # speaks exactly our version and the codec is enabled; the
+            # infer/generate tensor payloads on this connection then skip
+            # pickle entirely. An old client never sends this kind; an
+            # old server answers it {"ok": False, "error": ...} through
+            # the unknown-kind path — the client stays on pickle.
+            ok = bool(wire_codec_enabled()
+                      and msg.get("codec") == WIRE_CODEC_VERSION)
+            if ok and conn is not None:
+                mark_codec_socket(conn)
+            return {"ok": ok, "codec": WIRE_CODEC_VERSION}
         if kind == "infer":
             return self._handle_infer(msg)
         if kind == "generate":
@@ -260,8 +275,11 @@ class InferenceServer:
         inputs = dict(msg["inputs"])
         if msg.get("stream") and conn is not None:
             def emit(tokens, _conn=conn):
+                # int32 buffer, not a list of ints: on a codec-negotiated
+                # connection the cumulative token chunk travels as one
+                # raw tensor buffer (the client converts back to ints)
                 send_frame(_conn, {"kind": "gen_chunk",
-                                   "tokens": [int(t) for t in tokens]})
+                                   "tokens": np.asarray(tokens, np.int32)})
             inputs["stream"] = emit
         try:
             if self.fleet is not None:

@@ -23,7 +23,8 @@ import time
 import numpy as np
 import pytest
 
-from poseidon_tpu.parallel.async_ssp import (AsyncSSPClient, ParamService,
+from poseidon_tpu.parallel.async_ssp import (WIRE_CODEC_VERSION,
+                                             AsyncSSPClient, ParamService,
                                              _recv_msg, _send_msg,
                                              run_async_ssp_worker)
 from poseidon_tpu.runtime.faults import FaultProxy, FaultRule
@@ -246,10 +247,14 @@ def test_proxy_truncated_frame_is_replayed_exactly_once():
     proxy = FaultProxy(("127.0.0.1", svc.port))
     hello = pickle.dumps({"kind": "hello", "worker": 0},
                          protocol=pickle.HIGHEST_PROTOCOL)
-    # budget: the whole hello frame + 12 bytes — deterministically inside
-    # the first push frame (conn 0 is the push channel: it dials first)
+    wire_neg = pickle.dumps({"kind": "wire", "codec": WIRE_CODEC_VERSION},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+    # budget: the whole hello + codec-negotiation frames + 12 bytes —
+    # deterministically inside the first push frame (conn 0 is the push
+    # channel: it dials first)
     proxy.add_rule(FaultRule(action="truncate", conn=0,
-                             after_bytes=len(hello) + 8 + 12))
+                             after_bytes=len(hello) + 8
+                             + len(wire_neg) + 8 + 12))
     try:
         cli = AsyncSSPClient(0, proxy.addr, staleness=0, n_workers=1,
                              **FAST)

@@ -293,7 +293,11 @@ def test_shipped_schema_content_highlights():
     golden = P.load_schema()
     ps = golden["services"]["param_service"]
     assert set(ps["kinds"]) == {"hello", "push", "heartbeat", "pull",
-                                "admit", "retire", "clocks", "done", "bye"}
+                                "admit", "retire", "clocks", "done", "bye",
+                                "wire"}
+    wire = ps["kinds"]["wire"]
+    assert wire["mutating"] is False                # negotiation only
+    assert "codec" in wire["reply_keys"]
     assert ps["unhandled_kinds"] == []
     push = ps["kinds"]["push"]
     assert push["mutating"] is True
@@ -303,7 +307,7 @@ def test_shipped_schema_content_highlights():
     assert [k for k, v in ps["kinds"].items() if v["mutating"]] == ["push"]
     inf = golden["services"]["inference"]
     assert set(inf["kinds"]) == {"infer", "generate", "stats", "health",
-                                 "reload", "bye"}
+                                 "reload", "bye", "wire"}
     assert inf["unhandled_kinds"] == []
     assert "outputs" in inf["kinds"]["infer"]["reply_keys"]
     gen = inf["kinds"]["generate"]

@@ -594,8 +594,15 @@ def test_kill_mid_request_client_reconnects_and_completes():
 
     srv = _serve()
     proxy = FaultProxy(srv.addr)
-    # the FIRST connection is cut 40 bytes into the request frame
-    proxy.add_rule(FaultRule(action="sever", conn=0, after_bytes=40))
+    # the FIRST connection is cut 40 bytes into the request frame — past the
+    # wire-codec negotiation frame the client sends during _dial (header +
+    # pickled offer), so the cut tears the request itself, not the dial
+    import pickle
+    from poseidon_tpu.proto.wire import WIRE_CODEC_VERSION
+    neg = pickle.dumps({"kind": "wire", "codec": WIRE_CODEC_VERSION},
+                       protocol=pickle.HIGHEST_PROTOCOL)
+    proxy.add_rule(FaultRule(action="sever", conn=0,
+                             after_bytes=len(neg) + 8 + 40))
     try:
         cli = ServingClient(proxy.addr, retry_deadline_s=10.0,
                             backoff_base_s=0.01, backoff_cap_s=0.05)

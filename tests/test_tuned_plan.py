@@ -360,7 +360,7 @@ def test_autoloaded_plan_bitwise_equals_explicit_flags(tmp_path,
     knobs = {"conv_layout": "NHWC", "conv_strategy": "",
              "arena_bucket_mb": 1.0, "mesh": "",
              "device_prefetch": 0, "max_in_flight": 1,
-             "steps_per_dispatch": 1,
+             "steps_per_dispatch": 1, "wire_dtype": "",
              "serve_buckets": tp.BUILTIN_DEFAULTS["serve_buckets"]}
     store = tmp_path / "store"
     tp.save_plan(_plan_doc("plannet", knobs), cache_dir=str(store))

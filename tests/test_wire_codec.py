@@ -1,0 +1,519 @@
+"""Zero-copy tensor wire codec + error-feedback compressed deltas (ISSUE 18).
+
+The DCN tier's hot path used to pay pickle both ways: a full serialize copy
+on send and a parse copy on receive, for payloads that are almost entirely
+raw tensor bytes. The codec ships a tag-encoded metadata skeleton plus the
+tensors' own buffers (scatter-gather send, preallocated receive), and the
+wire-dtype compressor halves/quarters those bytes with EXACT error feedback
+riding the managed-communication residual. These tests pin the contracts
+that make both safe:
+
+1. fidelity — every supported leaf (all ndarray dtypes incl. bfloat16,
+   0-d/empty/non-contiguous arrays, nested trees, TOPK/q8 tuples, scalars,
+   str/bytes) roundtrips bitwise through encode/decode and the socket path;
+2. containment — truncated AND oversized frames raise FrameError (never a
+   silent pad/drop), and a lying length prefix is rejected BEFORE the
+   payload buffer is allocated (max_frame_bytes cap);
+3. compatibility — codec off (or an un-negotiated peer) is byte-for-byte
+   today's pickle wire; unsupported objects fall back to pickle per frame;
+4. exactness — ``sent + residual == update`` holds bitwise for every wire
+   dtype, dense and TOPK, so codec-on dense f32 equals the pickle path and
+   a bf16-wire 2-worker run is bitwise identical to dense at every gate
+   (power-of-two deltas, the managed-comm idiom).
+
+Every socket binds port 0 on loopback — no fixed ports, no flakes.
+"""
+
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.parallel.async_ssp import (AsyncSSPClient, ParamService,
+                                             _dense_f32, _quantize_leaf,
+                                             _quantize_tree,
+                                             resolve_wire_dtype, split_topk)
+from poseidon_tpu.proto import wire
+from poseidon_tpu.proto.wire import (CODEC_MAGIC, FrameError,
+                                     FrameTooLargeError,
+                                     decode_codec_payload,
+                                     encode_codec_payload, mark_codec_socket,
+                                     recv_frame_sized, send_frame,
+                                     set_max_frame_bytes, set_wire_codec,
+                                     socket_uses_codec, wire_stats)
+
+
+@pytest.fixture(autouse=True)
+def _restore_wire_globals():
+    yield
+    set_wire_codec(None)
+    set_max_frame_bytes(None)
+
+
+def _codec_roundtrip(obj):
+    enc = encode_codec_payload(obj)
+    assert enc is not None, f"codec refused {type(obj)}"
+    parts, n = enc
+    flat = b"".join(bytes(p) for p in parts)
+    assert len(flat) == n
+    return decode_codec_payload(flat)
+
+
+def _assert_leaf_equal(a, b):
+    if isinstance(a, np.ndarray):
+        assert isinstance(b, np.ndarray)
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.ascontiguousarray(a).tobytes() == b.tobytes()
+    elif isinstance(a, (list, tuple)):
+        assert type(a) is type(b) and len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_leaf_equal(x, y)
+    elif isinstance(a, dict):
+        assert isinstance(b, dict) and set(a) == set(b)
+        for k in a:
+            _assert_leaf_equal(a[k], b[k])
+    elif isinstance(a, np.generic):
+        assert type(a) is type(b) and a.tobytes() == b.tobytes()
+    elif isinstance(a, float) and a != a:          # NaN payloads survive
+        assert b != b
+    else:
+        assert type(a) is type(b) and a == b
+
+
+# --------------------------------------------------------------------------- #
+# 1. fidelity: roundtrip fuzz
+# --------------------------------------------------------------------------- #
+
+ALL_DTYPES = ["float32", "float64", "float16", "bfloat16", "int8", "uint8",
+              "int16", "int32", "int64", "uint32", "bool"]
+
+
+def _make(dtype_name: str, shape, rng):
+    import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+    dt = np.dtype(dtype_name)
+    if dt == np.bool_:
+        return np.asarray(rng.rand(*shape) > 0.5)
+    if np.issubdtype(dt, np.integer):
+        info = np.iinfo(dt)
+        return np.asarray(rng.randint(max(info.min, -1000),
+                                      min(info.max, 1000) + 1,
+                                      size=shape)).astype(dt)
+    return np.asarray(rng.randn(*shape) * 3).astype(dt)
+
+
+@pytest.mark.parametrize("dtype_name", ALL_DTYPES)
+def test_roundtrip_every_dtype_bitwise(dtype_name):
+    rng = np.random.RandomState(7)
+    for shape in [(5,), (3, 4), (2, 3, 4), (1,), (16, 16)]:
+        a = _make(dtype_name, shape, rng)
+        _assert_leaf_equal(a, _codec_roundtrip(a))
+
+
+def test_roundtrip_degenerate_arrays():
+    """0-d, empty, and non-contiguous leaves all survive; non-contiguous
+    comes back compacted (C order) with identical values."""
+    zero_d = np.float32(3.25) + np.zeros((), np.float32)
+    empty = np.zeros((0, 3), np.float32)
+    base = np.arange(24, dtype=np.float32).reshape(4, 6)
+    strided = base[::2, ::3]
+    transposed = base.T
+    for a in (zero_d, empty, strided, transposed):
+        b = _codec_roundtrip(a)
+        assert b.dtype == a.dtype and b.shape == a.shape
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # the decoded copy of a non-contiguous source is contiguous
+    assert _codec_roundtrip(strided).flags["C_CONTIGUOUS"]
+
+
+def test_roundtrip_nested_trees_and_topk_leaves():
+    rng = np.random.RandomState(3)
+    vals = rng.randn(7).astype(np.float32)
+    idx = np.array([1, 5, 9, 2, 44, 3, 0], np.int64)
+    msg = {
+        "kind": "push", "worker": 3, "clock": 12, "seq": None,
+        "ok": True, "frac": 0.25, "tag": b"\x00raw\xff", "name": "fc1/w",
+        "delta": {
+            "fc": {"w": rng.randn(4, 4).astype(np.float32),
+                   "b": ("topk", idx, vals)},
+            "conv": {"w": ("topk", idx[:3],
+                           ("q8", np.float32(0.125),
+                            np.array([1, -7, 127], np.int8)))},
+        },
+        "clocks": [0, 1, 2], "pair": (1, 2),
+        "scalar": np.float32(1.5),
+    }
+    _assert_leaf_equal(msg, _codec_roundtrip(msg))
+
+
+def test_roundtrip_fuzz_random_trees():
+    """Structured fuzz: 40 random nested trees mixing every supported
+    leaf kind, each roundtripped bitwise."""
+    rng = np.random.RandomState(1234)
+
+    def leaf(depth):
+        r = rng.randint(0, 10)
+        if r == 0:
+            return None
+        if r == 1:
+            return bool(rng.randint(2))
+        if r == 2:
+            return int(rng.randint(-10**12, 10**12))
+        if r == 3:
+            return float(rng.randn())
+        if r == 4:
+            return "s" * rng.randint(0, 9) + "π"
+        if r == 5:
+            return bytes(rng.randint(0, 256, size=rng.randint(0, 16))
+                         .astype(np.uint8).tobytes())
+        dt = ALL_DTYPES[rng.randint(len(ALL_DTYPES))]
+        shape = tuple(rng.randint(0, 5)
+                      for _ in range(rng.randint(0, 3)))
+        return _make(dt, shape, rng)
+
+    def tree(depth):
+        if depth >= 3 or rng.rand() < 0.3:
+            return leaf(depth)
+        r = rng.randint(3)
+        n = rng.randint(0, 4)
+        if r == 0:
+            return [tree(depth + 1) for _ in range(n)]
+        if r == 1:
+            return tuple(tree(depth + 1) for _ in range(n))
+        return {f"k{i}": tree(depth + 1) for i in range(n)}
+
+    for _ in range(40):
+        t = tree(0)
+        _assert_leaf_equal(t, _codec_roundtrip(t))
+
+
+def test_skeleton_depth_limit_falls_back_to_pickle():
+    deep = [1]
+    for _ in range(80):
+        deep = [deep]
+    assert encode_codec_payload(deep) is None      # caller pickles instead
+
+
+def test_unsupported_objects_fall_back_to_pickle():
+    for obj in ({1, 2, 3}, object(), {"x": {4: "non-str-key-ok"}},
+                np.ma.masked_array([1.0])):
+        enc = encode_codec_payload(obj)
+        if enc is not None:                        # dicts with int keys ARE
+            _assert_leaf_equal(obj, _codec_roundtrip(obj))   # supported
+
+
+# --------------------------------------------------------------------------- #
+# 2. containment: truncation, oversize, cap
+# --------------------------------------------------------------------------- #
+
+def _encode_flat(obj) -> bytes:
+    parts, n = encode_codec_payload(obj)
+    return b"".join(bytes(p) for p in parts)
+
+
+def test_truncated_payload_rejected_at_every_cut():
+    flat = _encode_flat({"fc": np.arange(12, dtype=np.float32)})
+    for cut in list(range(0, 12)) + [len(flat) - 7, len(flat) - 1]:
+        with pytest.raises(FrameError):
+            decode_codec_payload(flat[:cut])
+
+
+def test_oversized_payload_rejected():
+    flat = _encode_flat({"fc": np.arange(12, dtype=np.float32)})
+    with pytest.raises(FrameError, match="size mismatch|trailing"):
+        decode_codec_payload(flat + b"\x00\x00\x00\x00")
+
+
+def test_lying_skeleton_extents_rejected():
+    # skeleton claims more tensor bytes than the frame carries
+    flat = bytearray(_encode_flat(np.zeros(4, np.float32)))
+    # ndarray dim is a !Q at the end of the skeleton; inflate it
+    (skel_len,) = struct.unpack("!I", flat[4:8])
+    dim_off = 8 + skel_len - 8
+    flat[dim_off:8 + skel_len] = struct.pack("!Q", 1 << 40)
+    with pytest.raises(FrameError):
+        decode_codec_payload(bytes(flat))
+
+
+def test_header_over_cap_rejected_before_allocation():
+    """A lying length prefix is refused from the 8-byte header alone —
+    the receiver never allocates (or reads) a payload over the cap."""
+    a, b = socket.socketpair()
+    try:
+        set_max_frame_bytes(4096)
+        a.sendall(struct.pack("!Q", 1 << 33))      # 8 GiB claim, no payload
+        with pytest.raises(FrameError, match="exceeds cap"):
+            recv_frame_sized(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_send_over_cap_refused_loudly():
+    a, b = socket.socketpair()
+    try:
+        set_max_frame_bytes(1024)
+        mark_codec_socket(a)
+        with pytest.raises(FrameTooLargeError):
+            send_frame(a, np.zeros(4096, np.float32))   # codec path
+        with pytest.raises(FrameTooLargeError):
+            send_frame(a, np.zeros(4096, np.float32), codec=False)
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------------------------------- #
+# 3. compatibility: pickle byte-identity + per-socket negotiation state
+# --------------------------------------------------------------------------- #
+
+def _wire_bytes(obj, codec_marked: bool, codec_global: bool) -> bytes:
+    a, b = socket.socketpair()
+    try:
+        set_wire_codec(codec_global)
+        if codec_marked:
+            mark_codec_socket(a)
+        n = send_frame(a, obj)
+        a.shutdown(socket.SHUT_WR)
+        got = b.makefile("rb").read()
+        assert len(got) == n
+        return got
+    finally:
+        set_wire_codec(None)
+        a.close()
+        b.close()
+
+
+def test_codec_off_is_byte_identical_to_pickle():
+    obj = {"kind": "push", "delta": {"fc": np.arange(6, dtype=np.float32)}}
+    want = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    want = struct.pack("!Q", len(want)) + want
+    # kill switch off: even a negotiated socket speaks pickle
+    assert _wire_bytes(obj, codec_marked=True, codec_global=False) == want
+    # un-negotiated socket with the codec on: pickle, byte for byte
+    assert _wire_bytes(obj, codec_marked=False, codec_global=True) == want
+
+
+def test_codec_frames_flow_only_on_marked_sockets():
+    obj = {"x": np.arange(4, dtype=np.float32)}
+    raw = _wire_bytes(obj, codec_marked=True, codec_global=True)
+    assert raw[8:12] == CODEC_MAGIC
+    raw = _wire_bytes(obj, codec_marked=False, codec_global=True)
+    assert raw[8:9] == b"\x80"                     # pickle protocol marker
+
+
+def test_socket_roundtrip_codec_and_pickle_interleaved():
+    """One connection carrying codec frames, a pickle fallback frame
+    (unsupported object), and codec again — the receiver auto-detects per
+    frame, no state desync."""
+    a, b = socket.socketpair()
+    mark_codec_socket(a)
+    msgs = [{"d": np.arange(9, dtype=np.float32).reshape(3, 3)},
+            {"oops": {1, 2, 3}},                   # set -> pickle fallback
+            {"t": ("topk", np.array([0, 2], np.int64),
+                   np.array([1.5, -2.5], np.float32))}]
+    got = []
+
+    def rx():
+        for _ in msgs:
+            got.append(recv_frame_sized(b)[0])
+
+    t = threading.Thread(target=rx)
+    t.start()
+    try:
+        for m in msgs:
+            send_frame(a, m)
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        for m, g in zip(msgs, got):
+            _assert_leaf_equal(m, g)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_decoded_arrays_are_writable_views():
+    """The zero-copy contract: decoded arrays alias the per-frame receive
+    buffer and are WRITABLE (the apply path adds into them in place)."""
+    a, b = socket.socketpair()
+    mark_codec_socket(a)
+    try:
+        send_frame(a, {"w": np.arange(8, dtype=np.float32)})
+        obj, _ = recv_frame_sized(b)
+        obj["w"] += 1.0                            # must not raise
+        np.testing.assert_array_equal(
+            obj["w"], np.arange(8, dtype=np.float32) + 1.0)
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------------------------------- #
+# 4. exactness: error feedback + bitwise parity with the pickle path
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("wd", ["bf16", "f16", "int8"])
+def test_sent_plus_residual_reassembles_update_exactly(wd):
+    """The PR-12 invariant extended to every wire dtype: dequant(sent) +
+    residual == update, BITWISE, including zeros, denormal-range values
+    and f16-overflow magnitudes."""
+    rng = np.random.RandomState(5)
+    v = (rng.randn(4096).astype(np.float32) *
+         np.float32(10.0) ** rng.randint(-12, 10, size=4096)).astype(
+             np.float32)
+    v[:8] = [0.0, 1e-38, -1e-38, 7.125, -7.125, 1e30, -1e30, 65504.0]
+    leaf, residual, nbytes = _quantize_leaf(v.copy(), wd)
+    back = _dense_f32(leaf)
+    if residual is None:
+        residual = np.zeros_like(v)
+    re = back + residual
+    assert re.dtype == np.float32
+    np.testing.assert_array_equal(re, v)
+    assert nbytes < v.nbytes                       # compression is real
+
+
+@pytest.mark.parametrize("wd", ["bf16", "f16", "int8"])
+def test_quantize_tree_topk_exactness(wd):
+    """TOPK partials compress their VALUES too, and the quantization error
+    folds into exactly the selected entries' residual slots."""
+    rng = np.random.RandomState(9)
+    tree = {"fc": {"w": rng.randn(32, 32).astype(np.float32)}}
+    sent, kept, n_sent, n_total = split_topk(tree, 0.25)
+    assert 0 < n_sent < n_total
+    idx, vals = sent["fc"]["w"][1], sent["fc"]["w"][2]
+    leaf, err, nbytes = _quantize_leaf(vals.copy(), wd)
+    back = _dense_f32(leaf)
+    if err is None:
+        err = np.zeros_like(vals)
+    np.testing.assert_array_equal(back + err, vals)
+
+
+def test_quantize_tree_pow2_is_residual_free():
+    """Powers of two are exact in bf16 — the quantizer detects a lossless
+    pass and returns residual=None (nothing to carry)."""
+    tree = {"fc": {"w": (2.0 ** -(np.arange(16.0) % 6))
+                   .astype(np.float32).reshape(4, 4)}}
+    wt, residual, saved = _quantize_tree(tree, "bf16")
+    assert residual is None
+    assert saved > 0
+    np.testing.assert_array_equal(_dense_f32(wt["fc"]["w"]),
+                                  tree["fc"]["w"])
+
+
+def _zeros(shape=(4, 4)):
+    return {"fc": {"w": np.zeros(shape, np.float32)}}
+
+
+def _pow2_delta(worker: int, clock: int, shape=(4, 4)):
+    n = int(np.prod(shape))
+    exps = -(np.arange(n) % 6) - clock - 8 * worker
+    return {"fc": {"w": (2.0 ** exps).astype(np.float32).reshape(shape)}}
+
+
+def test_codec_on_dense_f32_equals_pickle_path_bitwise():
+    """The tentpole pin: the SAME dense f32 push stream through a codec
+    session and a pickle (codec-off) session produces bitwise-identical
+    anchors — the codec changes bytes-on-wire, never values."""
+    deltas = [_pow2_delta(0, c) for c in range(4)]
+
+    def run(codec_on: bool):
+        set_wire_codec(codec_on)
+        svc = ParamService(_zeros(), n_workers=1)
+        cli = AsyncSSPClient(0, ("127.0.0.1", svc.port), staleness=0,
+                             n_workers=1)
+        try:
+            for c, d in enumerate(deltas):
+                cli.push(d)
+                cli.gate(c + 1)
+            cli._drain()
+            # Negotiation state is per-socket, not a global counter, so a
+            # lingering handler thread from another session can't skew it.
+            negotiated = socket_uses_codec(cli._push_sock)
+            return svc.anchor["fc"]["w"].copy(), negotiated
+        finally:
+            set_wire_codec(None)
+            cli.close()
+            svc.close()
+
+    a_codec, codec_negotiated = run(True)
+    a_pickle, pickle_negotiated = run(False)
+    assert codec_negotiated is True                # negotiation really on
+    assert pickle_negotiated is False              # kill switch really off
+    np.testing.assert_array_equal(a_codec, a_pickle)
+    assert a_codec.tobytes() == a_pickle.tobytes()
+
+
+def test_two_worker_bf16_wire_bitwise_equal_to_dense_at_gates():
+    """The managed-comm acceptance test re-run under a compressed wire:
+    two workers, budget-tight bf16-wire arm vs the dense f32 arm — at
+    every SSP window boundary the anchor AND each worker's gate-time
+    applied state are bitwise identical (power-of-two deltas are bf16-
+    exact; the error-feedback residual carries everything else)."""
+    n_clocks, staleness = 8, 1
+    dense_svc = ParamService(_zeros(), n_workers=2)
+    wire_svc = ParamService(_zeros(), n_workers=2)
+    dense = [AsyncSSPClient(w, ("127.0.0.1", dense_svc.port),
+                            staleness=staleness, n_workers=2)
+             for w in range(2)]
+    wired = []
+    for w in range(2):
+        cli = AsyncSSPClient(w, ("127.0.0.1", wire_svc.port),
+                             staleness=staleness, n_workers=2,
+                             budget_mbps=1e-6, priority_frac=0.25,
+                             wire_dtype="bf16")
+        cli.budget.consume(1e12)                   # deep deficit: partials
+        wired.append(cli)
+    try:
+        for c in range(n_clocks):
+            for w in range(2):
+                d = _pow2_delta(w, c)
+                dense[w].push(d)
+                wired[w].push(d)
+            for w in range(2):
+                dense[w]._drain()
+                wired[w]._drain()
+            if (c + 1) % (staleness + 1) == 0:     # window boundary
+                assert np.array_equal(dense_svc.anchor["fc"]["w"],
+                                      wire_svc.anchor["fc"]["w"]), c
+                assert (dense_svc.anchor["fc"]["w"].tobytes()
+                        == wire_svc.anchor["fc"]["w"].tobytes())
+                for w in range(2):
+                    cache_d, _ = dense[w].refresh()
+                    cache_m, _ = wired[w].refresh()
+                    assert (cache_d["fc"]["w"].tobytes()
+                            == cache_m["fc"]["w"].tobytes()), (c, w)
+                    assert dense[w].gate(c + 1, timeout_s=10.0) is not None
+                    assert wired[w].gate(c + 1, timeout_s=10.0) is not None
+        assert all(m.partial_pushes > 0 for m in wired)
+        assert all(m.wire_bytes_saved > 0 for m in wired)
+    finally:
+        for cli in wired + dense:
+            cli.close()
+        wire_svc.close()
+        dense_svc.close()
+
+
+def test_wire_dtype_and_adarevision_refuse_to_compose():
+    svc = ParamService(_zeros(), n_workers=1, server_logic="adarevision")
+    try:
+        with pytest.raises(ValueError, match="adarevision"):
+            AsyncSSPClient(0, ("127.0.0.1", svc.port), staleness=0,
+                           n_workers=1, server_logic="adarevision",
+                           wire_dtype="bf16")
+    finally:
+        svc.close()
+
+
+def test_resolve_wire_dtype_normalization():
+    assert resolve_wire_dtype("") == ""
+    assert resolve_wire_dtype(None) == ""
+    assert resolve_wire_dtype("f32") == ""
+    assert resolve_wire_dtype("float32") == ""
+    assert resolve_wire_dtype("off") == ""
+    assert resolve_wire_dtype("BF16") == "bf16"
+    assert resolve_wire_dtype("f16") == "f16"
+    assert resolve_wire_dtype("int8") == "int8"
+    with pytest.raises(ValueError):
+        resolve_wire_dtype("int4")
