@@ -2185,6 +2185,261 @@ def tune_main(argv: list) -> None:
 
 
 # --------------------------------------------------------------------------- #
+# memory mode: `python bench.py memory` — peak-bytes vs step-time under remat
+# --------------------------------------------------------------------------- #
+
+def memory_main(argv: list) -> None:
+    """`bench.py memory`: the HBM budget planner's peak-bytes-vs-step-time
+    sweep. For the CNN models the no-remat step's real
+    ``memory_analysis()`` peak anchors a tight budget (``--budget_frac``
+    of it); the planner's knapsack (core/remat.plan_remat) picks layers
+    and the planned step is compiled and re-measured. Emits
+    ``remat_peak_bytes_ratio`` (planned peak / no-remat peak),
+    ``remat_step_overhead_frac`` (planned step ms / no-remat ms - 1) and
+    ``max_batch_at_budget`` (largest doubled batch whose maximal-remat
+    step still fits the no-remat base peak). For gpt_small the sweep is
+    per checkpoint policy (none / dots_saveable / nothing_saveable) over
+    the block stack instead of per layer. CPU runs are labeled proxy
+    (gpt_small additionally drops to a proxy shape, recorded in the
+    payload); the same command re-measures on TPU when the tunnel
+    returns. Evidence lands in evidence/memory/<model>_<backend>.json."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py memory")
+    ap.add_argument("--model", default="googlenet",
+                    choices=("alexnet", "googlenet", "gpt_small"))
+    ap.add_argument("--batch", type=int, default=0,
+                    help="per-device batch override (0 = mode default)")
+    ap.add_argument("--budget_frac", type=float, default=0.6,
+                    help="tight budget as a fraction of the no-remat peak")
+    ap.add_argument("--full", action="store_true",
+                    help="force full-size shapes (default: full on "
+                         "accelerators, smoke on the CPU proxy)")
+    ap.add_argument("--windows", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--max_doublings", type=int, default=3)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+
+    def fail_mem(error: str, probe: dict | None = None) -> None:
+        payload = {"metric": "remat_peak_bytes_ratio", "value": 0.0,
+                   "unit": "x", "vs_baseline": 0.0, "error": error}
+        if probe:
+            payload["probe"] = probe
+        emit(payload)
+        sys.exit(1)
+
+    cpu_ok = os.environ.get("POSEIDON_BENCH_CPU", "") == "1"
+    on_accel = False
+    probe: dict = {"platform": "cpu"}
+    if not cpu_ok:
+        probe = probe_backend(
+            float(os.environ.get("POSEIDON_BENCH_PROBE_TIMEOUT", "60")), 1)
+        on_accel = probe.get("platform") in ("tpu", "axon")
+    import jax
+    if not on_accel:
+        jax.config.update("jax_platforms", "cpu")
+    smoke = not (on_accel or args.full)
+
+    common = {"cpu_proxy": not on_accel, "model": args.model,
+              "backend": jax.default_backend(),
+              "device_kind": jax.devices()[0].device_kind,
+              "smoke_shapes": smoke}
+    doc: dict = dict(common)
+    try:
+        if args.model == "gpt_small":
+            results = _memory_sweep_lm(args, smoke, doc)
+        else:
+            results = _memory_sweep_cnn(args, smoke, doc)
+    except Exception as e:  # noqa: BLE001 — one JSON line on every path
+        import traceback
+        fail_mem(f"{type(e).__name__}: {e} | "
+                 f"{traceback.format_exc().strip().splitlines()[-1]}",
+                 probe)
+        return
+
+    out_path = args.out or os.path.join(
+        _REPO, "evidence", "memory",
+        f"{args.model}_{common['backend']}.json")
+    try:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, out_path)
+    except OSError as e:
+        print(f"[bench] memory evidence write failed: {e}",
+              file=sys.stderr, flush=True)
+
+    for metric, value, unit, extras in results:
+        emit({"metric": metric, "value": value, "unit": unit,
+              "vs_baseline": value, **common, **extras, "out": out_path})
+
+
+def _memory_sweep_cnn(args, smoke: bool, doc: dict) -> list:
+    """CNN arm of `bench.py memory`: no-remat baseline vs the budget-
+    planned step vs maximal remat, all real compiled-step measurements
+    through the tune stage's arm builder."""
+    from poseidon_tpu.core import remat as remat_mod
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.runtime.attribution import layer_cost_table
+    from poseidon_tpu.runtime.tuned_plan import (BUILTIN_DEFAULTS,
+                                                 _build_step_arm,
+                                                 _model_setup,
+                                                 interleaved_min_ms)
+
+    net_param, shapes = _model_setup(args.model, smoke)
+    if args.batch:
+        shapes["data"] = (args.batch,) + tuple(shapes["data"][1:])
+        shapes["label"] = (args.batch,)
+    arena = float(BUILTIN_DEFAULTS["arena_bucket_mb"])
+
+    def make(remat: str, batch: int | None = None):
+        s = dict(shapes)
+        if batch is not None:
+            s["data"] = (batch,) + tuple(shapes["data"][1:])
+            s["label"] = (batch,)
+        return _build_step_arm(net_param, s, "", arena, 1, "",
+                               remat=remat, measure_peak=True)
+
+    base = make("")
+    peak0 = int(base.peak_bytes)
+    if peak0 <= 0:
+        raise RuntimeError("memory_analysis() reported no peak on this "
+                           "backend; nothing to plan against")
+    budget = int(peak0 * args.budget_frac)
+    net = Net(net_param, phase="TRAIN", source_shapes=dict(shapes))
+    plan = remat_mod.plan_remat(
+        layer_cost_table(net), budget, peak0,
+        candidates=remat_mod.remat_candidates(net), source="measured")
+    planned = make(",".join(plan.layers))
+    full = make("auto")
+
+    arms = {"default": base, "planned": planned, "full_remat": full}
+    raw = interleaved_min_ms(arms, windows=args.windows, iters=args.iters)
+    ms = {k: raw[k] / arms[k].per_call_steps for k in raw}
+    peaks = {k: int(arms[k].peak_bytes) for k in arms}
+
+    # largest doubled batch the maximal-remat step fits in the no-remat
+    # base peak — activations scale with batch, params don't, so this is
+    # the planner's batch-autoscaling headroom in one number
+    base_batch = int(shapes["data"][0])
+    b = base_batch
+    if int(full.peak_bytes) <= peak0:
+        for _ in range(args.max_doublings):
+            nxt = make("auto", batch=b * 2)
+            if int(nxt.peak_bytes) > peak0:
+                break
+            b *= 2
+    doc.update({
+        "budget_frac": args.budget_frac, "budget_bytes": budget,
+        "base_batch": base_batch, "max_doublings": args.max_doublings,
+        "plan": plan.to_doc(),
+        "arms": {k: {"peak_bytes": peaks[k], "step_ms": round(ms[k], 4)}
+                 for k in arms},
+        "max_batch_at_budget": b,
+    })
+    ratio = peaks["planned"] / peak0
+    overhead = ms["planned"] / max(ms["default"], 1e-9) - 1.0
+    detail = {"budget_frac": args.budget_frac,
+              "planned_layers": len(plan.layers), "arms": doc["arms"]}
+    return [
+        ("remat_peak_bytes_ratio", round(ratio, 4), "x", detail),
+        ("remat_step_overhead_frac", round(overhead, 4), "frac", detail),
+        ("max_batch_at_budget", b, "rows/device",
+         {"base_batch": base_batch, "max_doublings": args.max_doublings}),
+    ]
+
+
+def _memory_sweep_lm(args, smoke: bool, doc: dict) -> list:
+    """LM arm of `bench.py memory`: gpt_small fwd+bwd per checkpoint
+    policy. The policy enum replaces the CNN per-layer knapsack — block
+    stacks trade whole tiers of saveables, not individual layers."""
+    import jax
+    import jax.numpy as jnp
+    from poseidon_tpu.core import remat as remat_mod
+    from poseidon_tpu.models.transformer import (TransformerConfig,
+                                                 forward, gpt_small_config,
+                                                 init_params, lm_loss)
+    from poseidon_tpu.runtime.tuned_plan import interleaved_min_ms
+
+    if smoke:
+        # proxy shape: same block anatomy, CPU-sized — labeled in the doc
+        cfg = TransformerConfig(vocab_size=2048, d_model=256, n_heads=8,
+                                n_layers=6, d_ff=1024, max_seq=256,
+                                remat=False)
+        bsz, seq = 2, 256
+    else:
+        cfg = gpt_small_config(max_seq=1024, remat=False)
+        bsz, seq = 8, 1024
+    doc["shape"] = {"vocab": cfg.vocab_size, "d_model": cfg.d_model,
+                    "n_layers": cfg.n_layers, "n_heads": cfg.n_heads,
+                    "d_ff": cfg.d_ff, "batch": bsz, "seq": seq,
+                    "proxy_shape": smoke}
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (bsz, seq), 0,
+                              cfg.vocab_size)
+    tgts = jax.random.randint(jax.random.PRNGKey(2), (bsz, seq), 0,
+                              cfg.vocab_size)
+
+    def make(policy: str, b: jax.Array, t: jax.Array):
+        def loss(p, bb, tt):
+            return lm_loss(forward(p, cfg, bb, remat_policy=policy), tt)
+        step = jax.jit(jax.value_and_grad(loss))
+        peak = remat_mod.measured_peak_bytes(
+            step.lower(params, b, t).compile())
+
+        def run():
+            l, g = step(params, b, t)
+            jax.block_until_ready(l)
+
+        run.per_call_steps = 1  # type: ignore
+        run.peak_bytes = peak  # type: ignore
+        return run
+
+    policies = ("none", "dots_saveable", "nothing_saveable")
+    arms = {p: make(p, toks, tgts) for p in policies}
+    peaks = {p: int(arms[p].peak_bytes) for p in policies}
+    if peaks["none"] <= 0:
+        raise RuntimeError("memory_analysis() reported no peak on this "
+                           "backend; nothing to plan against")
+    raw = interleaved_min_ms(arms, windows=args.windows, iters=args.iters)
+    ms = {p: raw[p] for p in raw}
+
+    # batch autoscaling headroom: doubled batches under nothing_saveable
+    # against the none-policy base peak
+    b, budget = bsz, peaks["none"]
+    for _ in range(args.max_doublings):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+        nb = jax.random.randint(k1, (b * 2, seq), 0, cfg.vocab_size)
+        nt = jax.random.randint(k2, (b * 2, seq), 0, cfg.vocab_size)
+        probe = jax.jit(jax.value_and_grad(
+            lambda p, bb, tt: lm_loss(
+                forward(p, cfg, bb, remat_policy="nothing_saveable"), tt)))
+        pk = remat_mod.measured_peak_bytes(
+            probe.lower(params, nb, nt).compile())
+        if pk > budget:
+            break
+        b *= 2
+    doc.update({
+        "arms": {p: {"peak_bytes": peaks[p], "step_ms": round(ms[p], 4)}
+                 for p in policies},
+        "max_batch_at_budget": b, "base_batch": bsz,
+        "max_doublings": args.max_doublings,
+    })
+    ratio = peaks["nothing_saveable"] / peaks["none"]
+    overhead = ms["nothing_saveable"] / max(ms["none"], 1e-9) - 1.0
+    detail = {"arms": doc["arms"]}
+    return [
+        ("remat_peak_bytes_ratio", round(ratio, 4), "x", detail),
+        ("remat_step_overhead_frac", round(overhead, 4), "frac", detail),
+        ("max_batch_at_budget", b, "rows",
+         {"base_batch": bsz, "max_doublings": args.max_doublings}),
+    ]
+
+
+# --------------------------------------------------------------------------- #
 # comms mode: `python bench.py comms` — dense vs managed over a throttled link
 # --------------------------------------------------------------------------- #
 
@@ -2553,5 +2808,7 @@ if __name__ == "__main__":
         fabric_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "tune":
         tune_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "memory":
+        memory_main(sys.argv[2:])
     else:
         main()

@@ -16,7 +16,7 @@ prove placement/comm properties rather than sample them:
   cycles, check-then-act, jax-from-thread (rules THR001-THR006).
 - ``jit_hygiene.py`` — host syncs inside traced functions and the engine's
   dispatch window, retrace hazards, f64 promotion, named_scope coverage
-  (rules JIT101-JIT105).
+  (rules JIT101-JIT106).
 - ``contracts.py`` — per-model golden HLO contracts
   (``evidence/hlo_contracts/*.json``): gradient all-reduce count, layout
   transposes, donation census, dtype census, fusion count — verified by

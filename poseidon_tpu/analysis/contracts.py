@@ -29,7 +29,10 @@ contracts with its planned collective schedule per (mesh, model).
 Version drift: counters are exact goldens only under the jax version that
 generated them (recorded in ``generated_with``). Under a different jax,
 the gate falls back to the robust subset — gradient all-reduce count,
-layout transposes, f64-freedom, donation non-emptiness — and says so.
+layout transposes, f64-freedom, donation non-emptiness, and the
+``memory`` section's analytic activation-bytes column (pure shape math;
+its LeNet-only ``measured_peak_bytes`` is compiler output and drops out)
+— and says so.
 """
 
 from __future__ import annotations
@@ -76,7 +79,12 @@ _BATCH = 8          # one row per device on the 8-device virtual mesh
 # gate (collective_consistency) relies on.
 ROBUST_KEYS = ("gradient_all_reduces", "layout_transposes", "f64_tensors",
                "mesh", "arena_buckets", "tp_modes", "planned_counts",
-               "lowered_counts", "planned_matches_lowered")
+               "lowered_counts", "planned_matches_lowered",
+               # the memory section's analytic half is pure shape math
+               # (attribution.layer_cost_table act_bytes) — exact under
+               # any jax; measured_peak_bytes is compiler output and is
+               # deliberately NOT here
+               "act_bytes_total", "remat_candidates", "max_reclaim_bytes")
 
 # the ops whose cross-participant divergence is a silent SPMD hang: a
 # mesh member waiting in a collective its peers never entered (or
@@ -275,6 +283,23 @@ def build_contract(model: str) -> Dict:
             # of the cross-participant consistency gate below
             "sequence": collective_sequence(mtxt),
         }
+    # the HBM budget planner's contract surface (core/remat.py): the
+    # analytic activation-bytes column the knapsack prices against, per
+    # model. Pure shape math — robust across jax versions.
+    from ..core import remat as remat_mod
+    from ..runtime.attribution import layer_cost_table
+    table = layer_cost_table(net)
+    zero_plan = remat_mod.plan_remat(
+        table, 0, 0, candidates=remat_mod.remat_candidates(net),
+        source="analytic")
+    contract["memory"] = {
+        "act_bytes_total": sum(int(r.get("act_bytes", 0))
+                               for r in table.values()),
+        "remat_candidates": len(remat_mod.remat_candidates(net)),
+        # what the zero-budget maximal plan reclaims (bytes) — the
+        # planner's full lever arm on this model
+        "max_reclaim_bytes": int(zero_plan.saved_bytes),
+    }
     if spec["optimized"]:
         compiled = lowered.compile()
         ctxt = compiled.as_text()
@@ -284,6 +309,11 @@ def build_contract(model: str) -> Dict:
             "layout_transposes": count_layout_transposes(ctxt),
             "fusion_count": _fusion_count(ctxt),
         }
+        # real memory_analysis() peak — compiler output (exact only
+        # under the recorded jax), riding the compile the optimized
+        # section already paid; LeNet-only by the compile-cost policy
+        contract["memory"]["measured_peak_bytes"] = \
+            remat_mod.measured_peak_bytes(compiled)
     return contract
 
 
@@ -417,7 +447,7 @@ def diff_contracts(golden: Dict, fresh: Dict) -> List[str]:
             diffs.append(f"{section}.{key}: golden {g!r} != measured {f!r}")
 
     for section in ("stablehlo", "nhwc", "collective_schedule",
-                    "optimized"):
+                    "memory", "optimized"):
         gsec = golden.get(section)
         if gsec is None:
             continue

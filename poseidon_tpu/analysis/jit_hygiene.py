@@ -31,6 +31,13 @@ properties are lexical — so they are lintable:
   ``jax.named_scope`` coverage (REQUIRED_SCOPES below). Removing one
   silently reclassifies that phase's device time into the
   ``(unattributed)`` residual row of the per-layer table.
+- **JIT106 checkpoint-body-scope**: in REMAT_SCOPE_FILES, a local
+  function handed to ``jax.checkpoint``/``jax.remat`` must itself
+  contain a ``named_scope`` call. The HBM budget planner (core/remat.py)
+  wraps chosen layers' forward bodies in ``jax.checkpoint``; the ops XLA
+  RECOMPUTES during backward carry only the scopes inside the
+  checkpointed body — a scope left outside it covers the forward pass
+  and silently drops the recompute cost into ``(unattributed)``.
 
 **Pallas kernel bodies** (functions passed — directly or through
 ``functools.partial`` — as the first argument of a ``pl.pallas_call``) are
@@ -89,6 +96,11 @@ REQUIRED_SCOPES: Dict[str, Tuple[str, ...]] = {
     "poseidon_tpu/parallel/strategies.py": ("grad_sync_bucket",),
     "poseidon_tpu/core/net.py": (),
 }
+
+# JIT106's scope: files where jax.checkpoint wraps attribution-scoped
+# layer bodies (the remat planner's wiring). Extend when another module
+# grows checkpointed per-layer forwards.
+REMAT_SCOPE_FILES: Set[str] = {"poseidon_tpu/core/net.py"}
 
 
 def _alias_map(tree: ast.Module) -> Dict[str, str]:
@@ -571,6 +583,51 @@ def lint_file(path: str, source: Optional[str] = None,
                     message=f"required named_scope {name!r} missing: its "
                             f"device time falls into the attribution "
                             f"table's (unattributed) residual"))
+
+    # ---- JIT106: checkpointed layer bodies keep their named_scope ------ #
+    if rel in REMAT_SCOPE_FILES:
+        def _has_named_scope(fdef) -> bool:
+            return any(
+                isinstance(c, ast.Call) and (
+                    (isinstance(c.func, ast.Attribute)
+                     and c.func.attr == "named_scope")
+                    or (isinstance(c.func, ast.Name)
+                        and c.func.id == "named_scope"))
+                for c in ast.walk(fdef))
+
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.Call) and n.args):
+                continue
+            f = n.func
+            is_ckpt = ((isinstance(f, ast.Attribute)
+                        and f.attr in ("checkpoint", "remat")
+                        and aliases.get(_root_of(f) or "") == "jax")
+                       or (isinstance(f, ast.Name)
+                           and f.id in ("checkpoint", "remat")
+                           and aliases.get(f.id) == "jax_member"))
+            if not is_ckpt or not isinstance(n.args[0], ast.Name):
+                continue
+            name = n.args[0].id
+            # innermost-first resolution against the qualname index; a
+            # name that resolves to no local def (e.g. a parameter) is
+            # out of this rule's lexical reach
+            cands = sorted((q for q in index
+                            if q == name or q.endswith("." + name)),
+                           key=len, reverse=True)
+            if not cands:
+                continue
+            fdef = index[cands[0]]
+            if not _has_named_scope(fdef):
+                findings.append(Finding(
+                    rule="JIT106", path=rel, line=n.lineno,
+                    symbol=cands[0], key=name,
+                    message=f"checkpointed body {name!r} has no "
+                            f"named_scope inside it: the ops recomputed "
+                            f"during backward carry only the scopes "
+                            f"INSIDE the jax.checkpoint body, so the "
+                            f"layer's recompute time falls into the "
+                            f"attribution table's (unattributed) "
+                            f"residual"))
 
     return findings
 

@@ -407,6 +407,7 @@ class Net:
         comm=None,
         keep_blobs: bool = False,
         input_layout: str = "NCHW",
+        remat=None,
     ) -> NetOutputs:
         """``input_layout`` names the physical layout of the CALLER's 4-D
         input blobs ("NCHW" default — the Caffe contract). Under an NHWC
@@ -414,7 +415,14 @@ class Net:
         generates device-side) makes the hot path transpose-free; feeding
         canonical NCHW costs exactly one entry transpose per image input.
         Outputs and ``keep_blobs`` are ALWAYS canonical NCHW — export,
-        HDF5 dumps and debug tooling never see the internal layout."""
+        HDF5 dumps and debug tooling never see the internal layout.
+
+        ``remat`` names layers (any iterable of layer names — usually a
+        ``core/remat.RematPlan.layer_set``) whose forward bodies run
+        under ``jax.checkpoint``: their top activations are dropped
+        after forward and recomputed from their (stored) bottoms during
+        backward. The wrap changes WHAT IS STORED, never the math —
+        remat arms are bitwise-equal to stored-activation arms."""
         if train is None:
             train = self.phase == "TRAIN"
         if comm is not None:
@@ -444,6 +452,11 @@ class Net:
                 converted[key] = NN.to_layout(v, have, want)
             return converted[key]
 
+        remat_set = frozenset(remat) if remat else frozenset()
+        unknown = remat_set - {l.name for l in self.layers}
+        if unknown:
+            raise ValueError(f"remat names unknown layers: "
+                             f"{sorted(unknown)}")
         loss = jnp.zeros((), jnp.float32)
         outputs: Dict[str, jax.Array] = {}
         for layer in self.layers:
@@ -456,13 +469,35 @@ class Net:
             # runtime/attribution.py joins both back). Bottom layout
             # conversions sit INSIDE the scope: a boundary transpose bills
             # to the layer that demanded it, not to the residual row.
-            with jax.named_scope(layer.name):
-                bottoms = [bottom_in(b, layer.run_layout)
-                           for b in lp.bottom]
-                tops = layer.apply(
-                    self._layer_params(params, layer, comm)
-                    if layer.params else {},
-                    bottoms, ctx)
+            if layer.name in remat_set:
+                # budget-planner remat (core/remat.py): checkpoint this
+                # layer's body — bottoms/params stay stored as the
+                # checkpoint's inputs, tops recompute during backward.
+                # The named_scope sits INSIDE the checkpointed function
+                # (the JIT106 contract): the recomputed ops must keep
+                # attributing to this layer, not the residual row. ctx
+                # (rng/comm) is closed over, not differentiated — the
+                # recompute replays the same dropout masks and the comm
+                # taps' custom_vjp rules fire once, in backward order.
+                with jax.named_scope(layer.name):
+                    bottoms = [bottom_in(b, layer.run_layout)
+                               for b in lp.bottom]
+                lparams = (self._layer_params(params, layer, comm)
+                           if layer.params else {})
+
+                def _body(lp_, bt_, _layer=layer):
+                    with jax.named_scope(_layer.name):
+                        return _layer.apply(lp_, bt_, ctx)
+
+                tops = jax.checkpoint(_body)(lparams, bottoms)
+            else:
+                with jax.named_scope(layer.name):
+                    bottoms = [bottom_in(b, layer.run_layout)
+                               for b in lp.bottom]
+                    tops = layer.apply(
+                        self._layer_params(params, layer, comm)
+                        if layer.params else {},
+                        bottoms, ctx)
             weights = layer.loss_weights(len(tops))
             for name, val, w in zip(lp.top, tops, weights):
                 blobs[name] = val

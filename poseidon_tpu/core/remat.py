@@ -1,0 +1,319 @@
+"""Measured HBM budget planner: per-layer activation remat as a policy.
+
+Every perf lever so far (layout, arena, kernels, wire codec) attacks
+time; this module attacks MEMORY — the axis that actually bounds the
+per-chip batch, and through it MFU, on real TPUs. The mechanism follows
+the repo's cost-based-optimizer discipline (Caffe con Troll,
+arXiv:1504.04343, via ops/conv_tune.py and runtime/tuned_plan.py):
+recomputation is a scheduler-level memory/compute trade (TensorFlow,
+arXiv:1605.08695), so the choice of WHICH activations to drop is made
+from measured numbers, not vibes:
+
+- the analytic side is the ``act_bytes`` column of
+  ``runtime/attribution.layer_cost_table`` — each layer's stored forward
+  activation footprint, priced against its forward recompute FLOPs;
+- the measured side is the compiled no-remat step's real
+  ``compiled.memory_analysis()`` peak (the same call
+  scripts/aot_tpu_check.py records per mesh arm), which anchors how many
+  bytes actually need reclaiming to fit ``--hbm_budget_gb``.
+
+:func:`plan_remat` closes the loop with a greedy cheapest-recompute-
+per-byte knapsack: drop stored activations (cheapest recompute first)
+until the deficit against the budget is covered. The resulting
+:class:`RematPlan` rides ``build_train_step(remat_plan=)`` /
+``build_spmd_train_step`` — ``core/net.Net.apply`` wraps the chosen
+layers' bodies in ``jax.checkpoint`` with the ``named_scope`` INSIDE the
+checkpointed function (the JIT106 contract: recomputed backward ops must
+keep attributing to their layer, never the residual row) — and the
+transformer family's ``remat`` flag generalizes to the policy enum below
+riding the same plan.
+
+Remat never changes the math: the recomputed forward replays the same
+ops on the same inputs, so remat arms stay BITWISE equal to
+stored-activation arms (tests/test_remat.py pins this through full
+Engine steps and the dp/fsdp mesh).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# The transformer-family remat policy enum ("riding the same plan"):
+#   none              store every block's internals (no checkpoint)
+#   dots_saveable     checkpoint blocks but keep matmul results — the
+#                     measured default (recompute only the cheap
+#                     elementwise/softmax tissue between dots)
+#   nothing_saveable  checkpoint blocks saving only block inputs — the
+#                     legacy remat=True behavior, maximal reclaim
+#   auto              defer to the RematPlan / TunedPlan row
+REMAT_POLICIES = ("none", "dots_saveable", "nothing_saveable", "auto")
+
+
+def normalize_policy(value) -> str:
+    """Fold the legacy bool flag and the enum spellings into one policy
+    name. ``True`` folds to ``nothing_saveable`` — the legacy code wrapped
+    blocks in bare ``jax.checkpoint``, whose default saves nothing, and the
+    fold must preserve that graph exactly (the per-block gradient-parity
+    anchors in test_transformer/test_moe pin it to within the old
+    tolerances). ``False``/``None``/``""`` mean ``none``."""
+    if value is None or value is False or value == "":
+        return "none"
+    if value is True:
+        return "nothing_saveable"
+    v = str(value).lower()
+    if v not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {value!r}; choose from {REMAT_POLICIES}")
+    return v
+
+
+def resolve_lm_policy(cfg_remat, plan_policy=None) -> str:
+    """Resolve the transformer family's effective policy from the config
+    flag and an (optional) plan row, refusing loudly on disagreement.
+
+    ``False`` (the dataclass default) is treated as UNSET — a plan may
+    enable remat under it. ``True`` and the string spellings are
+    EXPLICIT: an explicit flag that contradicts a concrete plan value is
+    a configuration error, never silently arbitrated. ``auto`` (either
+    side) defers to the other; when both sides defer (or only ``auto``
+    remains) the measured default ``dots_saveable`` applies."""
+    plan = normalize_policy(plan_policy) if plan_policy is not None \
+        else None
+    explicit = cfg_remat is not None and cfg_remat is not False \
+        and cfg_remat != ""
+    cfg = normalize_policy(cfg_remat)
+    if cfg == "auto":
+        explicit = False
+        cfg = "dots_saveable" if plan is None else plan
+    if plan is None or plan == "auto":
+        return "dots_saveable" if (plan == "auto" and not explicit) else cfg
+    if explicit and cfg != plan:
+        raise ValueError(
+            f"remat policy conflict: config says {cfg!r} but the plan "
+            f"says {plan!r} — drop the explicit flag (or set remat="
+            f"'auto') to follow the plan, or retire the plan row")
+    return plan if not explicit else cfg
+
+
+def checkpoint_policy(name: str):
+    """The jax checkpoint policy object for one enum member (None for
+    ``nothing_saveable`` — jax.checkpoint's own default)."""
+    import jax
+    name = normalize_policy(name)
+    if name in ("none", "auto"):
+        raise ValueError(f"policy {name!r} does not name a checkpoint "
+                         f"policy; resolve it first")
+    if name == "dots_saveable":
+        return jax.checkpoint_policies.dots_saveable
+    return None                                   # nothing_saveable
+
+
+def wrap_checkpoint(fn, policy_name: str):
+    """``fn`` wrapped in jax.checkpoint under ``policy_name`` (identity
+    for ``none``)."""
+    import jax
+    policy_name = normalize_policy(policy_name)
+    if policy_name == "none":
+        return fn
+    pol = checkpoint_policy(policy_name)
+    return jax.checkpoint(fn, policy=pol) if pol is not None \
+        else jax.checkpoint(fn)
+
+
+# --------------------------------------------------------------------------- #
+# the plan
+# --------------------------------------------------------------------------- #
+
+@dataclass(frozen=True)
+class RematPlan:
+    """One resolved remat decision, computed at step-build time.
+
+    ``layers`` names the Net-family layers whose forward bodies
+    ``Net.apply`` wraps in ``jax.checkpoint``; ``lm_policy`` is the
+    transformer family's block policy riding the same plan. The byte/
+    FLOP fields record what the knapsack claimed so stats.yaml and the
+    tuned store can say WHY these layers were chosen."""
+
+    budget_bytes: int = 0               # the target (0 = no budget given)
+    measured_peak_bytes: int = 0        # no-remat compiled peak (0 = n/a)
+    layers: Tuple[str, ...] = ()
+    saved_bytes: int = 0                # analytic activation bytes dropped
+    recompute_flops: float = 0.0        # analytic forward FLOPs re-paid
+    lm_policy: str = "none"
+    source: str = "analytic"            # analytic | measured | plan | flag
+
+    @property
+    def layer_set(self) -> frozenset:
+        return frozenset(self.layers)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.layers) or self.lm_policy != "none"
+
+    def describe(self) -> str:
+        if not self.active:
+            return "remat: off (fits the budget)"
+        mb = self.saved_bytes / 2**20
+        return (f"remat[{self.source}]: {len(self.layers)} layers, "
+                f"~{mb:.1f} MiB reclaimed, "
+                f"{self.recompute_flops / 1e6:.1f} MFLOP recompute"
+                + (f", lm={self.lm_policy}"
+                   if self.lm_policy != "none" else ""))
+
+    def to_doc(self) -> Dict:
+        return {"budget_bytes": int(self.budget_bytes),
+                "measured_peak_bytes": int(self.measured_peak_bytes),
+                "layers": list(self.layers),
+                "saved_bytes": int(self.saved_bytes),
+                "recompute_flops": float(self.recompute_flops),
+                "lm_policy": self.lm_policy,
+                "source": self.source}
+
+    @classmethod
+    def from_doc(cls, doc: Dict) -> "RematPlan":
+        return cls(budget_bytes=int(doc.get("budget_bytes", 0)),
+                   measured_peak_bytes=int(doc.get("measured_peak_bytes",
+                                                   0)),
+                   layers=tuple(doc.get("layers", ())),
+                   saved_bytes=int(doc.get("saved_bytes", 0)),
+                   recompute_flops=float(doc.get("recompute_flops", 0.0)),
+                   lm_policy=normalize_policy(doc.get("lm_policy",
+                                                      "none")),
+                   source=str(doc.get("source", "plan")))
+
+
+def remat_candidates(net) -> List[str]:
+    """Layer names eligible for per-layer checkpointing: layers that
+    consume bottoms (a data source has nothing to recompute FROM — its
+    top is the stored input either way) and produce a real top. Loss
+    heads stay eligible but their scalar tops price at ~0 bytes, so the
+    knapsack never wastes a pick on them."""
+    out = []
+    for layer in net.layers:
+        if not layer.lp.bottom or not layer.lp.top:
+            continue
+        out.append(layer.name)
+    return out
+
+
+def plan_remat(cost_table: Dict[str, Dict], budget_bytes: int,
+               peak_bytes: int,
+               candidates: Optional[Sequence[str]] = None,
+               lm_policy: str = "none",
+               source: str = "analytic") -> RematPlan:
+    """The greedy cheapest-recompute-per-byte knapsack.
+
+    ``cost_table`` is ``attribution.layer_cost_table(net)`` (the
+    ``act_bytes`` + ``flops`` columns); ``peak_bytes`` is the NO-remat
+    step's peak — measured via :func:`measured_peak_bytes` when a
+    compile is affordable, else the analytic activation total. Layers
+    drop (cheapest forward-recompute per reclaimed byte first) until
+    the deficit ``peak_bytes - budget_bytes`` is covered or every
+    candidate is spent.
+
+    Edge semantics the unit tests pin: ``budget_bytes <= 0`` means
+    maximal remat (every candidate drops — the "fit anywhere" request);
+    a budget at or above the peak is a no-op identity plan. Lower
+    budgets choose SUPERSETS of higher budgets' layers (the greedy
+    order is fixed, so the plan is monotone in the budget)."""
+    rows = []
+    names = list(candidates) if candidates is not None \
+        else list(cost_table)
+    for name in names:
+        row = cost_table.get(name)
+        if not row:
+            continue
+        act = int(row.get("act_bytes", 0))
+        if act <= 0:
+            continue
+        fwd_flops = float(row.get("flops", 0.0)) / 3.0   # table is 3x fwd
+        rows.append((fwd_flops / act, name, act, fwd_flops))
+    # fixed greedy order: cheapest recompute-per-byte first; name breaks
+    # ties so the plan is deterministic across processes (the collective-
+    # consistency property: every mesh participant must plan identically)
+    rows.sort(key=lambda r: (r[0], r[1]))
+    deficit = (float("inf") if budget_bytes <= 0
+               else int(peak_bytes) - int(budget_bytes))
+    chosen: List[str] = []
+    saved = 0
+    flops = 0.0
+    for _, name, act, fwd in rows:
+        if saved >= deficit:
+            break
+        chosen.append(name)
+        saved += act
+        flops += fwd
+    return RematPlan(budget_bytes=max(0, int(budget_bytes)),
+                     measured_peak_bytes=int(peak_bytes),
+                     layers=tuple(chosen), saved_bytes=saved,
+                     recompute_flops=flops,
+                     lm_policy=normalize_policy(lm_policy), source=source)
+
+
+# --------------------------------------------------------------------------- #
+# the measured side
+# --------------------------------------------------------------------------- #
+
+def measured_peak_bytes(compiled) -> int:
+    """The compiled step's peak live bytes from XLA's own buffer
+    assignment: arguments + outputs + temps, minus the aliased (donated)
+    overlap — the same ``memory_analysis()`` counters the AOT TPU
+    evidence records. Returns 0 when the runtime reports nothing (older
+    jaxlib / backends without the API)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:                       # noqa: BLE001 — optional API
+        return 0
+    if ma is None:
+        return 0
+    total = 0
+    for k in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes"):
+        total += int(getattr(ma, k, 0) or 0)
+    total -= int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+    return max(0, total)
+
+
+def default_budget_bytes(device=None, reserve_bytes: int = 0) -> int:
+    """The default ``--hbm_budget_gb``: the device's own memory limit
+    minus ``reserve_bytes`` (arena + optimizer state the caller knows
+    about). Returns 0 when the backend publishes no memory stats (the
+    CPU proxy) — callers must then pass an explicit budget."""
+    import jax
+    if device is None:
+        device = jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:                       # noqa: BLE001 — CPU has none
+        return 0
+    if not stats:
+        return 0
+    limit = int(stats.get("bytes_limit", 0) or 0)
+    return max(0, limit - int(reserve_bytes))
+
+
+def plan_for_net_step(net, lowerable, example_args: tuple,
+                      budget_bytes: int,
+                      lm_policy: str = "none") -> RematPlan:
+    """Compute a measured plan for one built (no-remat) train step:
+    compile it, read the real ``memory_analysis()`` peak, and run the
+    knapsack against the net's analytic activation column. The caller
+    rebuilds the step with ``remat_plan=`` when the plan is active —
+    remat is a trace-time property, so the no-remat compile is the
+    price of measuring (paid once per job config; the tuned store
+    memoizes the decision across processes)."""
+    from ..runtime.attribution import layer_cost_table
+    compiled = lowerable.lower(*example_args).compile()
+    peak = measured_peak_bytes(compiled)
+    table = layer_cost_table(net)
+    if peak <= 0:
+        # no memory API: fall back to the analytic activation total so a
+        # budget still produces a usable (if uncalibrated) plan
+        peak = int(sum(r.get("act_bytes", 0) for r in table.values()))
+        source = "analytic"
+    else:
+        source = "measured"
+    return plan_remat(table, budget_bytes, peak,
+                      candidates=remat_candidates(net),
+                      lm_policy=lm_policy, source=source)

@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import matmul_precision, policy
+from ..core.remat import resolve_lm_policy, wrap_checkpoint
 from ..ops.pallas_kernels import maybe_flash_attention
 from ..parallel.sequence import ring_attention
 from ..proto.messages import SolverParameter
@@ -40,10 +41,15 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 512
     max_seq: int = 1024
-    # rematerialize each block's activations in the backward pass
-    # (jax.checkpoint): HBM drops from O(layers x S x D) stored activations
-    # to O(S x D) per live block — the lever that lets long sequences fit
-    remat: bool = False
+    # rematerialize block activations in the backward pass (jax.checkpoint):
+    # HBM drops from O(layers x S x D) stored activations to O(S x D) per
+    # live block — the lever that lets long sequences fit. A policy enum
+    # (core/remat.REMAT_POLICIES): "none" | "dots_saveable" (keep matmul
+    # results, recompute the cheap tissue between them — the measured
+    # default) | "nothing_saveable" (save only block inputs, maximal
+    # reclaim) | "auto" (follow the RematPlan / TunedPlan row). The legacy
+    # bools still work: True means dots_saveable, False means unset.
+    remat: "bool | str" = False
 
     def n_params(self) -> int:
         """Parameter count (embeddings + blocks + head), for FLOPs/MFU."""
@@ -52,8 +58,8 @@ class TransformerConfig:
         return v * d + self.max_seq * d + v * d + 2 * d + L * block
 
 
-def gpt_small_config(max_seq: int = 1024, remat: bool = True) -> \
-        "TransformerConfig":
+def gpt_small_config(max_seq: int = 1024,
+                     remat: "bool | str" = True) -> "TransformerConfig":
     """The GPT-2-small shape (768d x 12L x 12h) — the LM family's
     performance identity config (round-4 verdict item 4: a model worth
     measuring, not the zoo-default toy). vocab 32768 keeps the embedding
@@ -163,18 +169,26 @@ def lm_head(params: Dict, x: jax.Array) -> jax.Array:
 
 def forward(params: Dict, cfg: TransformerConfig, tokens: jax.Array,
             *, seq_axis: Optional[str] = None,
-            pos_offset: jax.Array | int = 0) -> jax.Array:
+            pos_offset: jax.Array | int = 0,
+            remat_policy: Optional[str] = None) -> jax.Array:
     """tokens (B, S_local) -> logits (B, S_local, V). With ``seq_axis``,
-    attention runs as a ring over that mesh axis; everything else is local."""
+    attention runs as a ring over that mesh axis; everything else is local.
+
+    ``remat_policy`` is a plan-side override (the RematPlan / TunedPlan
+    row); it resolves against ``cfg.remat`` via
+    ``core/remat.resolve_lm_policy`` — an explicit config flag that
+    contradicts a concrete plan value refuses loudly."""
     x = embed_tokens(params, tokens, pos_offset)
 
     def block(x, blk):
         return block_forward(cfg, x, blk, seq_axis=seq_axis)
 
-    if cfg.remat:
-        # policy: keep only each block's input; everything inside (scores,
-        # probabilities, ffn intermediates) recomputes during backward
-        block = jax.checkpoint(block)
+    # policy-driven checkpoint: dots_saveable keeps matmul results and
+    # recomputes the elementwise/softmax tissue; nothing_saveable keeps
+    # only each block's input (scores, probabilities, ffn intermediates
+    # all recompute during backward)
+    block = wrap_checkpoint(block, resolve_lm_policy(cfg.remat,
+                                                     remat_policy))
     for i in range(len([k for k in params if k.startswith("block")])):
         x = block(x, params[f"block{i}"])
     return lm_head(params, x)
@@ -357,7 +371,8 @@ def build_dp_tp_train_step(cfg: TransformerConfig, sp: SolverParameter,
                            data_axis: str = "data",
                            tp_axis: str = "model",
                            seq_axis: Optional[str] = None,
-                           donate: bool = True):
+                           donate: bool = True,
+                           remat_policy: Optional[str] = None):
     """Training step over a 2-D (data x model) mesh — Megatron-style tensor
     parallelism built on XLA collectives instead of hand-written NCCL
     groups (the reference's distributed substrate, SURVEY §2.3; TP itself
@@ -400,9 +415,11 @@ def build_dp_tp_train_step(cfg: TransformerConfig, sp: SolverParameter,
     def block_tp(x, blk):
         return tp_block_forward(cfg, x, blk, f_op, g_op, seq_axis=seq_axis)
 
+    lm_policy = resolve_lm_policy(cfg.remat, remat_policy)
+
     def forward_tp(p, tokens, pos_offset):
         x = embed_tokens(p, tokens, pos_offset)
-        blk_fn = jax.checkpoint(block_tp) if cfg.remat else block_tp
+        blk_fn = wrap_checkpoint(block_tp, lm_policy)
         for i in range(cfg.n_layers):
             x = blk_fn(x, p[f"block{i}"])
         return lm_head(p, x)
@@ -494,7 +511,8 @@ def build_dp_pp_train_step(cfg: TransformerConfig, sp: SolverParameter,
                            data_axis: str = "data",
                            stage_axis: str = "stage",
                            tp_axis: Optional[str] = None,
-                           donate: bool = True):
+                           donate: bool = True,
+                           remat_policy: Optional[str] = None):
     """Training step over a 2-D (data x stage) mesh — GPipe-style pipeline
     parallelism as ONE differentiable compiled program, not a scheduler.
     Where a CUDA framework hand-writes a 1F1B schedule with per-stage
@@ -587,7 +605,8 @@ def build_dp_pp_train_step(cfg: TransformerConfig, sp: SolverParameter,
             loss = jnp.where(valid, -jnp.sum(picked) / n_tokens, 0.0)
             return lax.ppermute(x, stage_axis, perm), loss
 
-        tick_fn = jax.checkpoint(tick) if cfg.remat else tick
+        tick_fn = wrap_checkpoint(tick, resolve_lm_policy(cfg.remat,
+                                                          remat_policy))
 
         def loss_fn(pp):
             def tick_p(x, t):

@@ -719,8 +719,15 @@ def build_spmd_train_step(
     input_transform: Optional[Callable] = None,
     input_layout: str = "NCHW",
     sharded_state: bool = False,
+    remat_plan=None,
 ):
     """Compiled SPMD train step over a (data, fsdp, tp) mesh.
+
+    ``remat_plan`` (``core/remat.RematPlan``): the named layers' forward
+    bodies run under ``jax.checkpoint`` inside ``Net.apply``, dropping
+    their stored activations within the budget — orthogonal to the
+    sharding plan (it changes what is stored, never the collectives or
+    the math; remat arms are bitwise-equal to stored-activation arms).
 
     Canonical layout (default): keeps the
     ``(params, state, batch, rng) -> (params, state, metrics)`` contract
@@ -828,12 +835,16 @@ def build_spmd_train_step(
         # NOT folded by tp: dropout masks must match across tp replicas
         return jax.random.fold_in(rng, flat_idx)
 
+    # layers whose forward bodies Net.apply wraps in jax.checkpoint
+    _remat = (frozenset(remat_plan.layers)
+              if remat_plan is not None and remat_plan.layers else None)
+
     def _forward_backward(arena_bufs, excl_params, batch, rng):
         if layout is not None:
             def loss_fn(bufs, excl):
                 p = layout.merge(layout.views(*bufs), excl)
                 o = net.apply(p, batch, train=True, rng=rng, comm=ctx,
-                              input_layout=input_layout)
+                              input_layout=input_layout, remat=_remat)
                 return o.loss, o
 
             (bucket_grads, excl_grads), out = jax.grad(
@@ -842,7 +853,7 @@ def build_spmd_train_step(
         else:
             def loss_fn(excl):
                 o = net.apply(excl, batch, train=True, rng=rng, comm=ctx,
-                              input_layout=input_layout)
+                              input_layout=input_layout, remat=_remat)
                 return o.loss, o
 
             excl_grads, out = jax.grad(loss_fn, has_aux=True)(excl_params)

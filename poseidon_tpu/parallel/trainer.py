@@ -140,8 +140,18 @@ def build_train_step(
     iter_size: int = 1,
     input_layout: str = "NCHW",
     plan=None,
+    remat_plan=None,
 ) -> TrainStep:
     """Compiled SPMD train step over ``mesh``.
+
+    ``remat_plan`` (a ``core/remat.RematPlan``, from ``--hbm_budget_gb``
+    or the TunedPlan's measured remat row) wraps the named layers'
+    forward bodies in ``jax.checkpoint`` inside ``Net.apply`` — stored
+    activations drop until the step fits the HBM budget, at the cost of
+    recomputing those layers' forwards during backward. Composes with
+    the arena, the mesh planner and donation unchanged: remat changes
+    what XLA's buffer assignment keeps live, never the math (remat arms
+    are bitwise-equal to stored-activation arms).
 
     ``plan`` (a ``spmd.ShardingPlan``, from ``--mesh dp2,fsdp2,tp1``)
     routes the build to the sharding-planner step: arena buckets
@@ -227,8 +237,11 @@ def build_train_step(
         return build_spmd_train_step(
             net, sp, mesh, plan, comm, donate=donate,
             donate_batch=donate_batch, input_transform=input_transform,
-            input_layout=input_layout)
+            input_layout=input_layout, remat_plan=remat_plan)
     comm.wire_jnp_dtype()  # fail loudly on a bad wire_dtype string
+    # layers whose forward bodies Net.apply wraps in jax.checkpoint
+    _remat = (frozenset(remat_plan.layers)
+              if remat_plan is not None and remat_plan.layers else None)
     axis = comm.axis
     dcn = comm.dcn_axis
     axes = comm.sync_axes  # (dcn, data) or (data,)
@@ -335,7 +348,8 @@ def build_train_step(
                     def micro_loss(bufs, excl):
                         p = arena.merge(arena.views(*bufs), excl)
                         o = net.apply(p, mb, train=True, rng=mrng,
-                                      comm=None, input_layout=input_layout)
+                                      comm=None, input_layout=input_layout,
+                                      remat=_remat)
                         return o.loss, o
 
                     g, o = jax.grad(micro_loss, argnums=(0, 1),
@@ -343,7 +357,8 @@ def build_train_step(
                 else:
                     def micro_loss(p):
                         o = net.apply(p, mb, train=True, rng=mrng,
-                                      comm=None, input_layout=input_layout)
+                                      comm=None, input_layout=input_layout,
+                                      remat=_remat)
                         return o.loss, o
 
                     g, o = jax.grad(micro_loss, has_aux=True)(params)
@@ -389,7 +404,7 @@ def build_train_step(
                     p = arena.merge(arena.views(*bufs), excl)
                     o = net.apply(p, batch, train=True, rng=rng, comm=ctx,
                                   keep_blobs=bool(dump_blobs),
-                                  input_layout=input_layout)
+                                  input_layout=input_layout, remat=_remat)
                     return o.loss, o
 
                 (bucket_grads, grads), out = jax.grad(
@@ -404,7 +419,7 @@ def build_train_step(
                 def loss_fn(p):
                     o = net.apply(p, batch, train=True, rng=rng, comm=ctx,
                                   keep_blobs=bool(dump_blobs),
-                                  input_layout=input_layout)
+                                  input_layout=input_layout, remat=_remat)
                     return o.loss, o
 
                 grads, out = jax.grad(loss_fn, has_aux=True)(params)

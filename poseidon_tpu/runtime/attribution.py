@@ -478,15 +478,22 @@ def _shape_elems(shape) -> int:
 
 
 def layer_cost_table(net, dtype_bytes: int = 4) -> Dict[str, Dict]:
-    """{layer: {flops, bytes, intensity}} for one train step (fwd+bwd),
-    from blob/param shapes — the analytic model the FLOPs column joins
-    from (XLA's cost_analysis reports only the whole-module total).
+    """{layer: {flops, bytes, act_bytes, intensity}} for one train step
+    (fwd+bwd), from blob/param shapes — the analytic model the FLOPs
+    column joins from (XLA's cost_analysis reports only the whole-module
+    total).
 
     Conv/FC are exact MAC counts (x2 for mul+add; backward = dW + dX =
     2x forward). Pool/LRN/elementwise are per-element op estimates —
     they exist to rank sinks and compute intensity, not to be a
     simulator. Bytes = activations in + out + params, x3 for the
-    backward's re-reads and gradient writes."""
+    backward's re-reads and gradient writes.
+
+    ``act_bytes`` is the layer's STORED forward activation footprint —
+    the top blobs autodiff keeps live until the backward pass consumes
+    them. It is the per-layer column core/remat.py's budget knapsack
+    ranks against recompute FLOPs; an in-place top (same name as a
+    bottom) still counts once, matching what the trace stores."""
     out: Dict[str, Dict] = {}
     for layer in net.layers:
         lp = layer.lp
@@ -522,6 +529,7 @@ def layer_cost_table(net, dtype_bytes: int = 4) -> Dict[str, Dict]:
         out[layer.name] = {
             "flops": flops,
             "bytes": bytes_,
+            "act_bytes": int(out_elems) * int(dtype_bytes),
             "intensity": round(flops / bytes_, 3) if bytes_ else None,
         }
     return out

@@ -157,7 +157,9 @@ def _engine_from_args(args, phase_nets=True):
                   max_in_flight=getattr(args, "max_in_flight", None),
                   async_snapshot=getattr(args, "async_snapshot", None),
                   trace_out=getattr(args, "trace_out", "") or None,
-                  metrics_port=metrics_port if metrics_port >= 0 else None)
+                  metrics_port=metrics_port if metrics_port >= 0 else None,
+                  hbm_budget_gb=getattr(args, "hbm_budget_gb", None),
+                  remat=getattr(args, "remat", None) or None)
 
 
 def _enable_compile_cache_from_args(args) -> None:
@@ -211,6 +213,10 @@ def _apply_tuned_plan_train(args) -> None:
         explicit["steps_per_dispatch"] = args.steps_per_dispatch
     if getattr(args, "wire_dtype", ""):
         explicit["wire_dtype"] = args.wire_dtype
+    if getattr(args, "remat", None) is not None:
+        explicit["remat"] = args.remat
+    if getattr(args, "hbm_budget_gb", None) is not None:
+        explicit["hbm_budget_gb"] = args.hbm_budget_gb
 
     doc, store = None, ""
     if getattr(args, "tuned_plan", "auto") != "off":
@@ -235,6 +241,8 @@ def _apply_tuned_plan_train(args) -> None:
     args.steps_per_dispatch = knobs["steps_per_dispatch"]
     args.device_prefetch = knobs["device_prefetch"]
     args.max_in_flight = knobs["max_in_flight"]
+    args.remat = knobs["remat"]
+    args.hbm_budget_gb = knobs["hbm_budget_gb"]
 
 
 def cmd_train(args) -> int:
@@ -1145,6 +1153,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "ordered exact element ranges; <= 0 = one bucket "
                         "per leaf). Unset = TunedPlan value if one is "
                         "persisted, else 4.0")
+    t.add_argument("--hbm_budget_gb", type=float, default=None,
+                   help="per-device HBM budget (GiB) for the measured "
+                        "remat planner (core/remat.py): the no-remat "
+                        "train step compiles once, its real "
+                        "memory_analysis() peak is read, and a greedy "
+                        "cheapest-recompute-per-byte knapsack drops "
+                        "stored activations (jax.checkpoint on the "
+                        "chosen layers) until the step fits. Negative = "
+                        "auto-detect the device's own HBM limit; 0 = "
+                        "off. Unset = TunedPlan value if persisted, "
+                        "else off")
+    t.add_argument("--remat", default=None,
+                   help="activation remat override: a comma-separated "
+                        "layer list checkpoints exactly those layers "
+                        "(no measuring compile), 'auto' plans against "
+                        "--hbm_budget_gb, 'none' forces remat off. "
+                        "Unset = TunedPlan value if persisted, else "
+                        "off. Conflicts with a persisted plan refuse "
+                        "loudly rather than silently arbitrating")
     t.add_argument("--bf16", action="store_true",
                    help="the documented bf16 training path: bfloat16 "
                         "compute (MXU-native) + the exact space-to-depth "

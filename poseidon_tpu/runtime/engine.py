@@ -106,6 +106,8 @@ class Engine:
         async_snapshot: Optional[bool] = None,
         trace_out: Optional[str] = None,
         metrics_port: Optional[int] = None,
+        hbm_budget_gb: Optional[float] = None,
+        remat: Optional[str] = None,
     ):
         self.sp = sp
         # step-pipeline knobs: explicit args win, else the global policy
@@ -247,6 +249,7 @@ class Engine:
         self._train_param = train_param  # retained: reshard_data rebuilds
         self.train_pipelines, train_shapes = self._build_pipelines(
             train_param, "TRAIN")
+        self._train_shapes = train_shapes  # per-device; remat probe scales
         self.train_net = Net(train_param, "TRAIN", source_shapes=train_shapes)
         if self.mesh_cfg is not None and self.mesh_cfg.active:
             from ..parallel.spmd import ShardingPlan
@@ -331,6 +334,33 @@ class Engine:
         donate_batch = self._use_prefetch and jax.default_backend() != "cpu"
         self._donate_batch = donate_batch
 
+        # --- measured HBM budget planner (core/remat.py) ------------------ #
+        # --hbm_budget_gb fits the compiled train step's real
+        # memory_analysis() peak under a byte budget by rematerializing
+        # the cheapest-recompute activations (greedy knapsack against the
+        # attribution table's act_bytes column); --remat either forces an
+        # explicit layer list (skipping the measuring compile) or says
+        # "auto" (plan against the budget) / "none" (off). The plan is
+        # computed ONCE here, then rides build_train_step(remat_plan=).
+        self.remat_plan = None
+        self.hbm_budget_gb = hbm_budget_gb
+        _want_plan = ((remat or "").strip().lower() not in ("", "none")
+                      or (hbm_budget_gb is not None and hbm_budget_gb != 0))
+        if _want_plan and staleness > 0:
+            log("WARNING: --hbm_budget_gb/--remat are ignored under SSP "
+                "staleness (the local-step path has no remat wiring yet)",
+                rank=self.rank)
+        elif _want_plan:
+            self.remat_plan = self._plan_remat(remat, hbm_budget_gb,
+                                               donate_batch)
+        if self.remat_plan is not None and not self.remat_plan.active:
+            self.remat_plan = None  # fits the budget: identity plan
+        if self.remat_plan is not None:
+            log(self.remat_plan.describe(), rank=self.rank)
+            # stats.yaml says WHAT dropped and WHY (budget, measured
+            # peak, claimed bytes) — the tuned-plan provenance discipline
+            self.stats.set_section("remat", self.remat_plan.to_doc())
+
         # --- compiled steps ---------------------------------------------- #
         if staleness > 0:
             # SSP (ssp_consistency_controller.cpp): each device runs local
@@ -371,7 +401,7 @@ class Engine:
                 self.train_net, sp, self.mesh, self.comm, dump_blobs=dump,
                 input_transform=self._input_transform,
                 iter_size=self.iter_size, donate_batch=donate_batch,
-                plan=self.plan)
+                plan=self.plan, remat_plan=self.remat_plan)
 
         # --- multi-step dispatch (scan chunks) ---------------------------- #
         # K optimizer steps per compiled dispatch: amortizes the runtime's
@@ -396,7 +426,8 @@ class Engine:
                     self.train_net, sp, self.mesh, self.comm,
                     scan_steps=self.steps_per_dispatch,
                     input_transform=self._input_transform,
-                    iter_size=self.iter_size)
+                    iter_size=self.iter_size,
+                    remat_plan=self.remat_plan)
         self.eval_steps = [
             build_eval_step(n, self.mesh, dcn_axis=self.comm.dcn_axis,
                             plan=self.plan)
@@ -506,6 +537,79 @@ class Engine:
             shard=shard if shard is not None else self._data_shard,
             memory_data=self.memory_data,
             device_transform=(self._device_transform and phase == "TRAIN"))
+
+    def _plan_remat(self, remat, hbm_budget_gb, donate_batch):
+        """Resolve the remat decision for this job config (called once,
+        before step building). Three spellings:
+
+        - ``remat`` = comma-separated layer names: trust the operator,
+          price the list against the attribution table, skip the
+          measuring compile entirely (source="flag");
+        - ``remat`` = "auto" and/or a budget: build the NO-remat step,
+          compile it against abstract batch avals, read the real
+          ``memory_analysis()`` peak, and run the knapsack
+          (source="measured"; the no-remat compile is the price of
+          measuring — the tuned store memoizes the decision);
+        - ``hbm_budget_gb`` < 0: auto-detect the device's own HBM limit
+          (``default_budget_bytes``); refuses quietly on backends with
+          no memory stats (the CPU proxy needs an explicit budget).
+        """
+        from ..core import remat as remat_mod
+        from .attribution import layer_cost_table
+        table = layer_cost_table(self.train_net)
+        names = [s.strip() for s in str(remat or "").split(",")
+                 if s.strip() and s.strip().lower() not in ("none",
+                                                            "auto")]
+        if names:
+            known = {l.name for l in self.train_net.layers}
+            unknown = sorted(set(names) - known)
+            if unknown:
+                raise ValueError(
+                    f"--remat names unknown layers: {unknown}")
+            return remat_mod.RematPlan(
+                budget_bytes=0,
+                layers=tuple(names),
+                saved_bytes=sum(int(table.get(n, {}).get("act_bytes", 0))
+                                for n in names),
+                recompute_flops=sum(
+                    float(table.get(n, {}).get("flops", 0.0)) / 3.0
+                    for n in names),
+                source="flag")
+        if hbm_budget_gb is not None and hbm_budget_gb < 0:
+            budget = remat_mod.default_budget_bytes()
+            if budget <= 0:
+                log("WARNING: --hbm_budget_gb auto needs device memory "
+                    "stats (none on this backend); pass an explicit "
+                    "budget — skipping remat planning", rank=self.rank)
+                return None
+        else:
+            budget = int(float(hbm_budget_gb or 0) * 2**30)
+        # the measuring probe: the SAME step config the engine is about
+        # to build, minus remat, lowered against abstract avals (no
+        # params materialize here — eval_shape carries the pytrees)
+        probe = build_train_step(
+            self.train_net, self.sp, self.mesh, self.comm,
+            input_transform=self._input_transform,
+            iter_size=self.iter_size, donate_batch=donate_batch,
+            plan=self.plan)
+        params_avals = jax.eval_shape(self.train_net.init,
+                                      jax.random.PRNGKey(0))
+        groups = comm_error_groups(self.comm, self.mesh)
+        state_avals = jax.eval_shape(
+            lambda p: init_train_state(p, self.comm, groups), params_avals)
+        batch_avals = {}
+        for k, s in self._train_shapes.items():
+            g = (int(s[0]) * self.n_dev,) + tuple(int(d) for d in s[1:])
+            if self.iter_size > 1:
+                g = (self.iter_size,) + g
+            # rank-1 source blobs are the data layers' label tops
+            dt = jnp.int32 if len(s) == 1 else jnp.float32
+            batch_avals[k] = jax.ShapeDtypeStruct(g, dt)
+        return remat_mod.plan_for_net_step(
+            self.train_net, probe.lowerable,
+            (params_avals, state_avals, batch_avals,
+             jax.random.PRNGKey(7)),
+            budget)
 
     def reshard_data(self, shard: Shard) -> bool:
         """Re-key the TRAIN data assignment (elastic membership: the async

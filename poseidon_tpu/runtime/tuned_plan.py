@@ -18,6 +18,10 @@ to the repo's own knobs:
   max_in_flight        in-flight dispatch window
   steps_per_dispatch   optimizer steps per compiled dispatch (lax.scan)
   serve_buckets        the serving tier's batch bucket ladder
+  remat / batch_size / the measured HBM budget pair (core/remat.py): at
+  hbm_budget_gb        the job's own measured peak as the budget, does
+                       checkpointing activations buy enough extra batch
+                       to win on img/s?
 
 One ``TunedPlan`` JSON per (model, backend, n_devices) lives in the
 compile-cache tuned store (``runtime/compile_cache.load_tuned/save_tuned``,
@@ -85,10 +89,17 @@ BUILTIN_DEFAULTS: Dict[str, Any] = {
     # managed DCN delta wire dtype ('' = f32 byte-for-byte; bf16/f16/int8
     # compress with exact error feedback riding the comm residual)
     "wire_dtype": "",
+    # measured HBM budget planner (core/remat.py): '' = no remat, 'auto'
+    # = checkpoint per the budget knapsack; hbm_budget_gb 0 = no budget;
+    # batch_size is the measured largest-admissible batch AT that budget
+    # (informational — the prototxt owns the actual batch; 0 = unmeasured)
+    "remat": "",
+    "hbm_budget_gb": 0.0,
+    "batch_size": 0,
 }
 TRAIN_KNOBS = ("conv_layout", "conv_strategy", "arena_bucket_mb", "mesh",
                "device_prefetch", "max_in_flight", "steps_per_dispatch",
-               "wire_dtype")
+               "wire_dtype", "remat", "hbm_budget_gb")
 
 
 # --------------------------------------------------------------------------- #
@@ -304,7 +315,9 @@ def apply_training_resolution(res: PlanResolution) -> Dict[str, Any]:
             "mesh": mesh,
             "steps_per_dispatch": int(v["steps_per_dispatch"]),
             "device_prefetch": int(v["device_prefetch"]),
-            "max_in_flight": int(v["max_in_flight"])}
+            "max_in_flight": int(v["max_in_flight"]),
+            "remat": str(v.get("remat", "")),
+            "hbm_budget_gb": float(v.get("hbm_budget_gb", 0.0))}
 
 
 # --------------------------------------------------------------------------- #
@@ -409,6 +422,11 @@ def search_space(smoke: bool, n_devices: int) -> Dict[str, List]:
         # managed DCN wire dtype, measured over a throttled loopback link
         # (the f32 default is always a candidate — revert-if-losing)
         "wire_dtype": ["", "bf16"] if smoke else ["", "bf16", "f16", "int8"],
+        # the (remat, batch_size) coordinate pair: at a fixed budget (the
+        # no-remat default-batch measured peak) find the largest
+        # admissible batch per remat policy, race on img/s ('' default
+        # always a candidate — revert-if-losing)
+        "remat_batch": ["", "auto"],
     }
 
 
@@ -460,16 +478,28 @@ def _model_setup(model: str, smoke: bool):
 
 def _build_step_arm(net_param, shapes, conv_layout: str, arena_mb: float,
                     scan_steps: int, mesh_spec: str,
-                    conv_strategy: str = ""):
+                    conv_strategy: str = "", remat: str = "",
+                    measure_peak: bool = False):
     """One measured arm: a compiled train step under one knob assignment,
     returned as a zero-arg blocked callable (state threads through a
     holder so successive calls are real successive steps). The callable's
     ``per_call_steps`` attribute normalizes scan arms to per-optimizer-
-    step time."""
+    step time.
+
+    ``remat="auto"`` checkpoints every eligible layer (the zero-budget
+    maximal plan — what the (remat, batch) stage races against the
+    stored-activation default); any other non-empty ``remat`` is a
+    comma-joined explicit layer list (the Engine ``--remat`` flag
+    semantics — bench.py memory's budget-planned arm rides this).
+    ``measure_peak=True`` additionally
+    AOT-compiles the step and records its real ``memory_analysis()``
+    peak as ``run.peak_bytes`` (a second compile — only the remat stage
+    pays it)."""
     import jax
     import jax.numpy as jnp
 
     from .. import config
+    from ..core import remat as remat_mod
     from ..core.net import Net
     from ..parallel import (CommConfig, build_train_step, init_train_state,
                             make_mesh)
@@ -483,6 +513,16 @@ def _build_step_arm(net_param, shapes, conv_layout: str, arena_mb: float,
     comm = CommConfig(param_arena=True, arena_bucket_mb=float(arena_mb))
     nhwc = net.conv_layout == "NHWC"
     in_layout = "NHWC" if nhwc else "NCHW"
+    rp = None
+    if remat == "auto":
+        from .attribution import layer_cost_table
+        rp = remat_mod.plan_remat(
+            layer_cost_table(net), 0, 0,
+            candidates=remat_mod.remat_candidates(net), source="plan")
+    elif remat:
+        rp = remat_mod.RematPlan(
+            layers=tuple(t.strip() for t in remat.split(",") if t.strip()),
+            source="flag")
     if mesh_spec:
         from ..config import MeshConfig
         from ..parallel.spmd import ShardingPlan, named_mesh
@@ -490,13 +530,14 @@ def _build_step_arm(net_param, shapes, conv_layout: str, arena_mb: float,
         mesh = named_mesh(mesh_cfg)
         plan = ShardingPlan.build(net, mesh_cfg, comm)
         ts = build_train_step(net, sp, mesh, comm, plan=plan,
-                              input_layout=in_layout)
+                              input_layout=in_layout, remat_plan=rp)
         n_batch_dev = mesh_cfg.data * mesh_cfg.fsdp
     else:
         ts = build_train_step(net, sp, make_mesh(), comm,
                               scan_steps=scan_steps if scan_steps > 1
                               else None,
-                              scan_reuse_batch=True, input_layout=in_layout)
+                              scan_reuse_batch=True, input_layout=in_layout,
+                              remat_plan=rp)
         n_batch_dev = jax.device_count()
     params = net.init(jax.random.PRNGKey(0))
     state = init_train_state(params, comm, jax.device_count())
@@ -524,6 +565,26 @@ def _build_step_arm(net_param, shapes, conv_layout: str, arena_mb: float,
         jax.block_until_ready(m["loss"])
 
     run.per_call_steps = max(1, ts.scan_steps or 1)  # type: ignore
+    run.global_rows = rows  # type: ignore
+    if measure_peak:
+        compiled = ts.lowerable.lower(params, state, batch, rng).compile()
+
+        # the AOT compile does NOT seed the jit call cache, so timing
+        # through ts.step would compile the same program a second time
+        # (minutes per arm on the CPU proxy's conv models) — run the AOT
+        # executable itself instead
+        def run_aot():
+            # the raw device_step returns (params, state, metrics, dumps)
+            # — ts.step's wrapper strips the tail, the AOT call does not
+            out = compiled(holder["params"], holder["state"], batch, rng)
+            holder["params"], holder["state"] = out[0], out[1]
+            jax.block_until_ready(out[2]["loss"])
+
+        run_aot.per_call_steps = run.per_call_steps  # type: ignore
+        run_aot.global_rows = rows  # type: ignore
+        run_aot.peak_bytes = remat_mod.measured_peak_bytes(  # type: ignore
+            compiled)
+        return run_aot
     return run
 
 
@@ -546,6 +607,93 @@ def _measure_step_knob(net_param, shapes, current: Dict[str, Any],
     raw = interleaved_min_ms(arms, windows=windows, iters=iters)
     return {name: round(raw[name] / arms[name].per_call_steps, 4)
             for name in raw}
+
+
+def _measure_remat_batch(net_param, shapes, current: Dict[str, Any],
+                         windows: int, iters: int,
+                         max_doublings: int = 3) -> Dict[str, Any]:
+    """The (remat, batch_size) coordinate pair at a FIXED byte budget.
+
+    The budget is the no-remat default-batch step's measured
+    ``memory_analysis()`` peak — i.e. "the HBM this job config already
+    needs". Per remat policy ('' stored activations, 'auto' maximal
+    checkpoint) the largest ADMISSIBLE batch is found by doubling from
+    the default while the measured peak stays within the budget (at most
+    ``max_doublings`` doublings — recorded, never a silent cap); the
+    arms then race on img/s through ``interleaved_min_ms``. Remat wins
+    only when dropping activations buys enough extra batch to beat the
+    default's throughput — the revert-if-losing discipline.
+
+    Returns {"remat", "batch_size", "hbm_budget_gb", "trial"}."""
+    def make(policy: str, batch: int, measure_peak: bool):
+        s = dict(shapes)
+        s["data"] = (batch,) + tuple(shapes["data"][1:])
+        s["label"] = (batch,)
+        return _build_step_arm(
+            net_param, s, current["conv_layout"],
+            float(current["arena_bucket_mb"]), 1, "",
+            current.get("conv_strategy", ""), remat=policy,
+            measure_peak=measure_peak)
+
+    base_batch = int(shapes["data"][0])
+    probes: Dict[Tuple[str, int], Any] = {}
+    probes[("", base_batch)] = make("", base_batch, True)
+    budget = int(probes[("", base_batch)].peak_bytes)
+    trial: Dict[str, Any] = {
+        "budget_bytes": budget, "base_batch": base_batch,
+        "max_doublings": max_doublings, "arms": {}}
+    if budget <= 0:
+        # no memory API on this backend: nothing to plan against — the
+        # default wins by fiat, and the doc says why
+        trial["note"] = ("memory_analysis() reported no peak; remat/"
+                         "batch not measured on this backend")
+        return {"remat": "", "batch_size": 0, "hbm_budget_gb": 0.0,
+                "trial": trial}
+    best: Dict[str, Tuple[int, Any]] = {}
+    for policy in ("", "auto"):
+        b, arm = base_batch, probes.get((policy, base_batch))
+        if arm is None:
+            arm = make(policy, base_batch, True)
+        if arm.peak_bytes > budget and policy:  # remat arm at base batch
+            # can only be <= the default's peak, but keep the guard honest
+            trial["arms"][policy or "default"] = {
+                "batch": base_batch, "peak_bytes": int(arm.peak_bytes),
+                "admissible": False}
+            continue
+        for _ in range(max_doublings):
+            nxt = make(policy, b * 2, True)
+            if nxt.peak_bytes > budget:
+                break
+            b, arm = b * 2, nxt
+        best[policy] = (b, arm)
+        trial["arms"][policy or "default"] = {
+            "batch": b, "peak_bytes": int(arm.peak_bytes),
+            "admissible": True}
+    fns = {(p or "default"): arm for p, (b, arm) in best.items()}
+    raw = interleaved_min_ms(fns, windows=windows, iters=iters)
+    imgs = {}
+    for p, (b, arm) in best.items():
+        name = p or "default"
+        ms = raw[name] / arm.per_call_steps
+        imgs[name] = arm.global_rows / max(ms, 1e-9) * 1e3  # img/s
+        trial["arms"][name].update(step_ms=round(ms, 4),
+                                   img_per_s=round(imgs[name], 1))
+    winner = max(imgs, key=imgs.get)
+    default_ips = imgs.get("default", 0.0)
+    if winner != "default" and imgs[winner] <= default_ips:
+        winner = "default"
+    trial["winner"] = winner
+    trial["speedup"] = round(imgs[winner] / max(default_ips, 1e-9), 4)
+    policy = "" if winner == "default" else winner
+    # the budget knob ships only with a winning remat row: a default win
+    # must not make every later train run re-pay the measuring compile
+    # for an identity plan (the trial row keeps budget_bytes either way)
+    return {"remat": policy,
+            "batch_size": int(best[policy][0]) if policy in best
+            else base_batch,
+            "hbm_budget_gb": (round(budget / 2**30, 6) if policy
+                              else 0.0),
+            "trial": trial}
 
 
 def _measure_pipeline_knob(candidates: List[Tuple[int, int]], windows: int,
@@ -986,6 +1134,26 @@ def run_tune(model: str, *, smoke: bool = False, force: bool = False,
              "measured (throttled loopback; f32 default always a "
              "candidate)")
 
+    # ---- measured HBM budget: the (remat, batch_size) pair --------------- #
+    # at the job config's own measured peak as the budget, does dropping
+    # activations buy enough extra batch to win on img/s? ('' stored-
+    # activation default always a candidate — revert-if-losing)
+    remat = str(BUILTIN_DEFAULTS["remat"])
+    tuned_batch = int(BUILTIN_DEFAULTS["batch_size"])
+    hbm_gb = float(BUILTIN_DEFAULTS["hbm_budget_gb"])
+    if "remat_batch" not in skipped:
+        rb = _measure_remat_batch(net_param, source_shapes, current,
+                                  windows, iters)
+        remat, tuned_batch = rb["remat"], rb["batch_size"]
+        hbm_gb = rb["hbm_budget_gb"]
+        arms = rb["trial"].get("arms", {})
+        note("remat_batch", list(arms),
+             {n: a.get("step_ms", 0.0) for n, a in arms.items()
+              if "step_ms" in a},
+             f"{remat or 'default'}@batch{tuned_batch or '-'}",
+             "measured (img/s at fixed measured-peak budget)")
+        trials["remat_batch"].update(rb["trial"])  # the full per-arm rows
+
     # ---- LLM serving: page size, rung ladder, replica x tp --------------- #
     # greedy coordinate descent at the deep-overload operating point (the
     # saturated end of the offered-load curve bench.py serving_llm sweeps);
@@ -1047,6 +1215,9 @@ def run_tune(model: str, *, smoke: bool = False, force: bool = False,
             "llm_prompt_buckets": str(BUILTIN_DEFAULTS["llm_prompt_buckets"]),
             "llm_replicas_tp": llm_rt,
             "wire_dtype": wire_dtype,
+            "remat": remat,
+            "batch_size": tuned_batch,
+            "hbm_budget_gb": hbm_gb,
         },
         "trials": trials,
         "ab": ab,

@@ -836,6 +836,126 @@ def section_mesh(topo) -> dict:
     return out
 
 
+def section_memory(topo) -> dict:
+    """The HBM budget planner's off-tunnel evidence (core/remat.py): the
+    abstract-v5e per-device HBM bill under each remat arm — (a) the
+    AlexNet dp2 x fsdp2 SHARDED-STATE step with no plan vs the
+    zero-budget maximal plan (what ``--hbm_budget_gb`` buys when the
+    knapsack must reclaim everything), and (b) the GPT-small dp2 x tp4
+    step under each checkpoint policy (none / dots_saveable /
+    nothing_saveable). Peak = argument + output + temp - alias, the same
+    counter the runtime planner measures against."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from poseidon_tpu.config import MeshConfig
+    from poseidon_tpu.core import remat as remat_mod
+    from poseidon_tpu.core.net import Net
+    from poseidon_tpu.models import zoo
+    from poseidon_tpu.parallel import CommConfig
+    from poseidon_tpu.parallel.mesh import SPMD_AXES
+    from poseidon_tpu.parallel.spmd import (ShardingPlan,
+                                            build_spmd_train_step,
+                                            sharded_state_avals)
+    from poseidon_tpu.proto.messages import SolverParameter
+    from poseidon_tpu.runtime.attribution import layer_cost_table
+
+    def mem(compiled) -> dict:
+        ma = compiled.memory_analysis()
+        d = {k: int(getattr(ma, k, 0)) for k in
+             ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes")}
+        d["peak_bytes"] = remat_mod.measured_peak_bytes(compiled)
+        return d
+
+    sp = SolverParameter(base_lr=0.01, lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0005)
+    comm = CommConfig()
+    out = {}
+
+    # ---- AlexNet dp2 x fsdp2 sharded-state: no plan vs maximal plan --- #
+    mcfg = MeshConfig(data=2, fsdp=2, tp=1)
+    mesh = Mesh(np.array(topo.devices[:4]).reshape(2, 2, 1), SPMD_AXES)
+    image, per_dev = 227, 16
+    net = Net(zoo.alexnet(num_classes=1000, with_accuracy=False),
+              phase="TRAIN",
+              source_shapes={"data": (per_dev, 3, image, image),
+                             "label": (per_dev,)})
+    gbatch = per_dev * 4
+    batch_avals = {
+        "data": jax.ShapeDtypeStruct(
+            (gbatch, 3, image, image), jnp.float32,
+            sharding=NamedSharding(mesh, P(("data", "fsdp")))),
+        "label": jax.ShapeDtypeStruct(
+            (gbatch,), jnp.int32,
+            sharding=NamedSharding(mesh, P(("data", "fsdp"))))}
+    rng_aval = jax.ShapeDtypeStruct(
+        (2,), jnp.uint32, sharding=NamedSharding(mesh, P()))
+    max_plan = remat_mod.plan_remat(
+        layer_cost_table(net), 0, 0,
+        candidates=remat_mod.remat_candidates(net), source="plan")
+    for arm, rp in (("no_remat", None), ("max_remat", max_plan)):
+        t0 = time.time()
+        plan = ShardingPlan.build(net, mcfg, comm, shard_params=True)
+        ts = build_spmd_train_step(net, sp, mesh, plan, comm,
+                                   donate=False, sharded_state=True,
+                                   remat_plan=rp)
+        st = sharded_state_avals(net, ts.arena, plan, mesh)
+        compiled = ts.lowerable.lower(st, batch_avals, rng_aval).compile()
+        out[f"alexnet_fsdp2_{arm}"] = {
+            "mesh": mcfg.describe(), "global_batch": gbatch,
+            "image": image,
+            "remat_layers": len(rp.layers) if rp is not None else 0,
+            "hbm": mem(compiled),
+            "compile_seconds": round(time.time() - t0, 1)}
+        print(f"[aot]   memory/alexnet_fsdp2_{arm}: "
+              f"{out[f'alexnet_fsdp2_{arm}']['hbm']}", flush=True)
+    base = out["alexnet_fsdp2_no_remat"]["hbm"]["peak_bytes"]
+    if base:
+        out["alexnet_peak_bytes_ratio"] = round(
+            out["alexnet_fsdp2_max_remat"]["hbm"]["peak_bytes"] / base, 4)
+
+    # ---- GPT-small dp2 x tp4: per checkpoint policy ------------------- #
+    from poseidon_tpu import config as pconfig
+    from poseidon_tpu.models.transformer import (build_dp_tp_train_step,
+                                                 gpt_small_config,
+                                                 init_params, to_tp_layout)
+    from poseidon_tpu.solvers.updates import init_state
+    rs = np.random.RandomState(0)
+    mesh8 = _mesh(topo, ("data", "model"), (2, 4))
+    seq, lm_gbatch = 1024, 16
+    # cfg.remat stays unset so each arm's plan-side policy resolves
+    # without a conflict (core/remat.resolve_lm_policy)
+    cfg = gpt_small_config(max_seq=seq, remat=False)
+    lm_peaks = {}
+    for policy in ("none", "dots_saveable", "nothing_saveable"):
+        t0 = time.time()
+        with pconfig.policy_scope(compute_dtype=jnp.bfloat16):
+            lp = to_tp_layout(init_params(cfg, jax.random.PRNGKey(0)), cfg)
+            step = build_dp_tp_train_step(cfg, sp, mesh8, lp, donate=False,
+                                          remat_policy=policy)
+            ls = init_state(lp)
+            toks = jnp.asarray(rs.randint(0, cfg.vocab_size,
+                                          (lm_gbatch, seq),
+                                          dtype=np.int32))
+            compiled = step.lower(lp, ls, toks, toks,
+                                  jax.random.PRNGKey(1)).compile()
+        out[f"lm_gpt_small_dp2_tp4_{policy}"] = {
+            "seq": seq, "global_batch": lm_gbatch, "hbm": mem(compiled),
+            "compile_seconds": round(time.time() - t0, 1)}
+        lm_peaks[policy] = out[
+            f"lm_gpt_small_dp2_tp4_{policy}"]["hbm"]["peak_bytes"]
+        print(f"[aot]   memory/lm_gpt_small_{policy}: "
+              f"{out[f'lm_gpt_small_dp2_tp4_{policy}']['hbm']}", flush=True)
+    if lm_peaks.get("none"):
+        out["lm_peak_bytes_ratio"] = {
+            p: round(lm_peaks[p] / lm_peaks["none"], 4)
+            for p in ("dots_saveable", "nothing_saveable")}
+    return out
+
+
 # ------------------------------------------------------------------------- #
 # 6. Headline-config search: layout x stem rewrite, ranked by the cost model
 # ------------------------------------------------------------------------- #
@@ -1006,10 +1126,12 @@ def section_tune(topo) -> dict:
                         f"--force"),
         }
     # every collapsed knob must have a default AND appear in the space
-    # (pipeline covers device_prefetch+max_in_flight as one trial)
+    # (pipeline covers device_prefetch+max_in_flight as one trial;
+    # remat_batch covers the remat/batch_size/hbm_budget_gb triple)
     space_knobs = set(spaces["alexnet"]["search_space"])
-    covered = (space_knobs - {"pipeline"}) | {"device_prefetch",
-                                              "max_in_flight"}
+    covered = (space_knobs - {"pipeline", "remat_batch"}) | {
+        "device_prefetch", "max_in_flight",
+        "remat", "batch_size", "hbm_budget_gb"}
     missing = sorted(set(BUILTIN_DEFAULTS) - covered)
     ok = not missing and all(
         len(s["search_space"]["mesh"]) >= 3 for s in spaces.values())
@@ -1032,6 +1154,7 @@ SECTIONS = {
     "lm_gpt_small": section_lm_gpt_small,
     "lm_long_context": section_lm_long_context,
     "mesh": section_mesh,
+    "memory": section_memory,
     "cnn_configs": section_cnn_configs,
 }
 

@@ -859,6 +859,51 @@ def test_named_scope_contract_fires_when_scope_removed():
         assert not _jit(f.read(), path=path)
 
 
+_JIT106_FIXTURE = """
+    import jax
+
+    def apply(layers, params, x):
+        def _body(p, b):
+            {scope_site}
+        for layer in layers:
+            with jax.named_scope(layer.name):
+                pass  # forward-only scope: the recompute escapes it
+            x = jax.checkpoint(_body)(params, x)
+        return x
+"""
+
+
+def test_jit106_checkpoint_body_without_scope_fires():
+    """A checkpointed layer body with the named_scope OUTSIDE it: the ops
+    XLA recomputes during backward carry no layer scope, so the remat
+    planner's recompute cost would vanish into (unattributed)."""
+    out = _jit(_JIT106_FIXTURE.format(scope_site="return b * p"),
+               path=os.path.join(REPO, "poseidon_tpu/core/net.py"))
+    assert any(f.rule == "JIT106" and f.key == "_body" for f in out), out
+
+
+def test_jit106_quiet_twin_scope_inside_body():
+    """Same fixture with the scope moved INSIDE the checkpointed body —
+    quiet; and the rule stays scoped to REMAT_SCOPE_FILES (the identical
+    defect in a file outside the table is not its business)."""
+    good = _JIT106_FIXTURE.format(
+        scope_site='with jax.named_scope("layer"):\n'
+                   '                return b * p')
+    out = _jit(good, path=os.path.join(REPO, "poseidon_tpu/core/net.py"))
+    assert not [f for f in out if f.rule == "JIT106"], out
+    elsewhere = _jit(_JIT106_FIXTURE.format(scope_site="return b * p"))
+    assert not [f for f in elsewhere if f.rule == "JIT106"], elsewhere
+
+
+def test_jit106_real_net_module_is_quiet():
+    """The shipped core/net.py keeps its named_scope inside the
+    checkpointed _body (the wiring the rule exists to protect)."""
+    path = os.path.join(REPO, "poseidon_tpu/core/net.py")
+    with open(path) as f:
+        out = _jit(f.read(), path=path)
+    assert not [x for x in out if x.rule == "JIT106"], out
+
+
 # --------------------------------------------------------------------------- #
 # end-to-end: the shipped tree is clean vs the shipped baseline
 # --------------------------------------------------------------------------- #

@@ -361,6 +361,7 @@ def test_autoloaded_plan_bitwise_equals_explicit_flags(tmp_path,
              "arena_bucket_mb": 1.0, "mesh": "",
              "device_prefetch": 0, "max_in_flight": 1,
              "steps_per_dispatch": 1, "wire_dtype": "",
+             "remat": "", "hbm_budget_gb": 0.0,
              "serve_buckets": tp.BUILTIN_DEFAULTS["serve_buckets"]}
     store = tmp_path / "store"
     tp.save_plan(_plan_doc("plannet", knobs), cache_dir=str(store))
